@@ -1,0 +1,17 @@
+"""CLI subcommand registry. Commands are added as subsystems land."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def register(sub: "argparse._SubParsersAction") -> None:
+    p_version = sub.add_parser("version", help="print framework version")
+    p_version.set_defaults(func=_cmd_version)
+
+
+def _cmd_version(args) -> int:
+    import cilium_tpu
+    print(json.dumps({"version": cilium_tpu.__version__}))
+    return 0

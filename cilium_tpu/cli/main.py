@@ -1,0 +1,29 @@
+"""cilium-tpu CLI (analog of upstream ``cilium-dbg``).
+
+Subcommands grow with the framework; ``trace`` is the policy-trace parity
+debugging tool (upstream: ``cilium policy trace``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cilium-tpu",
+        description="TPU-native packet-classification framework CLI",
+    )
+    sub = parser.add_subparsers(dest="command")
+    from cilium_tpu.cli import commands
+    commands.register(sub)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 1
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

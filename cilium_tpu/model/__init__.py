@@ -1,0 +1,19 @@
+"""Data model: labels, selectors, rules, identities, ipcache.
+
+Analog of upstream ``pkg/labels``, ``pkg/policy/api``, ``pkg/identity``,
+``pkg/ipcache`` (paths per SURVEY.md §2 — reconstructed, reference mount empty).
+"""
+
+from cilium_tpu.model.labels import Label, Labels, parse_label
+from cilium_tpu.model.selectors import EndpointSelector
+from cilium_tpu.model.rules import Rule, parse_rule, parse_rules
+from cilium_tpu.model.identity import Identity, IdentityAllocator
+from cilium_tpu.model.ipcache import IPCache
+
+__all__ = [
+    "Label", "Labels", "parse_label",
+    "EndpointSelector",
+    "Rule", "parse_rule", "parse_rules",
+    "Identity", "IdentityAllocator",
+    "IPCache",
+]

@@ -1,0 +1,30 @@
+"""Local endpoint model (thin analog of upstream ``pkg/endpoint``).
+
+An endpoint is one local workload interface (pod). It owns a security
+identity (from its labels), a set of IPs (mirrored into the ipcache), and a
+per-endpoint policy image slot in the compiled snapshot. Lifecycle/regen
+orchestration lives in ``cilium_tpu/runtime``; this module is just the data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from cilium_tpu.model.labels import Labels
+
+
+@dataclass
+class Endpoint:
+    ep_id: int                       # local endpoint id (small int, dense)
+    labels: Labels
+    ips: Tuple[str, ...] = ()
+    identity_id: int = 0             # filled by the allocator at registration
+    # Per-endpoint enforcement override (None → follow daemon config), the
+    # analog of upstream's per-endpoint PolicyEnforcement option.
+    enforcement: Optional[str] = None
+    policy_revision: int = 0         # last repository revision realized on device
+
+    def __post_init__(self):
+        if not (0 <= self.ep_id):
+            raise ValueError("endpoint id must be non-negative")

@@ -1,0 +1,89 @@
+"""Host-side IPCache (analog of upstream ``pkg/ipcache`` + ``pkg/maps/ipcache``).
+
+Maps IP prefixes → security identity ids. This host store is the source of
+truth; the compiler lowers a snapshot of it into the stride-LPM tensor
+(``cilium_tpu/compile/lpm.py``). Lookup misses resolve to ``reserved:world``,
+matching the datapath's behavior (eps.h: no entry → WORLD_ID).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import normalize_prefix, parse_addr, parse_prefix
+
+
+class IPCache:
+    """prefix(canonical str) → identity id, with longest-prefix-match lookup."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, int] = {}
+        self._revision = 0
+        self._observers: List[Callable[[], None]] = []
+
+    def add_observer(self, obs: Callable[[], None]) -> None:
+        self._observers.append(obs)
+
+    def _changed(self) -> None:
+        self._revision += 1
+        for obs in list(self._observers):
+            obs()
+
+    # -- mutation ------------------------------------------------------------
+    def upsert(self, prefix: str, identity_id: int) -> None:
+        with self._lock:
+            self._entries[normalize_prefix(prefix)] = identity_id
+            self._changed()
+
+    def delete(self, prefix: str) -> bool:
+        with self._lock:
+            ok = self._entries.pop(normalize_prefix(prefix), None) is not None
+            if ok:
+                self._changed()
+            return ok
+
+    # -- queries -------------------------------------------------------------
+    @property
+    def revision(self) -> int:
+        return self._revision
+
+    def snapshot(self) -> Dict[str, int]:
+        """Copy of entries; the compiler's input."""
+        with self._lock:
+            return dict(self._entries)
+
+    def lookup(self, addr: str) -> int:
+        """Host-side reference LPM lookup (slow; the device LPM tensor must
+        agree with this exactly — the oracle uses it)."""
+        with self._lock:
+            return lpm_lookup(self._entries, addr)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def lpm_lookup(entries: Dict[str, int], addr: str) -> int:
+    """Longest-prefix-match over canonical prefix→id entries; miss → WORLD.
+
+    IPv4 addresses only match IPv4 prefixes and IPv6 only IPv6 — upstream
+    keeps two separate LPM maps (cilium_ipcache v4/v6), so ``::/0`` must not
+    cover v4-mapped addresses. The device side mirrors this with two stride
+    tries selected by the packet's family bit.
+    """
+    addr16, addr_is_v6 = parse_addr(addr)
+    addr_int = int.from_bytes(addr16, "big")
+    best_len = -1
+    best_id = C.IDENTITY_WORLD
+    for prefix, ident in entries.items():
+        net16, plen, pfx_is_v6 = parse_prefix(prefix)
+        if pfx_is_v6 != addr_is_v6:
+            continue
+        net_int = int.from_bytes(net16, "big")
+        if plen == 0 or (addr_int >> (128 - plen)) == (net_int >> (128 - plen)):
+            if plen > best_len:
+                best_len = plen
+                best_id = ident
+    return best_id

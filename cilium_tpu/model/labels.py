@@ -1,0 +1,118 @@
+"""Label model (analog of upstream ``pkg/labels``).
+
+A label is ``source:key=value``. Sources seen in practice: ``k8s``,
+``reserved``, ``cidr``, ``unspec``; selectors may use source ``any`` to match a
+key regardless of source. Identity is a function of the *sorted* label set, so
+``Labels`` keeps a canonical sorted representation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+SOURCE_ANY = "any"
+SOURCE_K8S = "k8s"
+SOURCE_RESERVED = "reserved"
+SOURCE_CIDR = "cidr"
+SOURCE_UNSPEC = "unspec"
+
+
+@dataclass(frozen=True, order=True)
+class Label:
+    source: str
+    key: str
+    value: str = ""
+
+    def __str__(self) -> str:
+        if self.value:
+            return f"{self.source}:{self.key}={self.value}"
+        return f"{self.source}:{self.key}"
+
+    @property
+    def source_key(self) -> str:
+        return f"{self.source}:{self.key}"
+
+
+def parse_label(text: str, default_source: str = SOURCE_UNSPEC) -> Label:
+    """Parse ``[source:]key[=value]``."""
+    value = ""
+    if "=" in text:
+        text, value = text.split("=", 1)
+    if ":" in text:
+        source, key = text.split(":", 1)
+    else:
+        source, key = default_source, text
+    return Label(source=source, key=key, value=value)
+
+
+class Labels:
+    """An immutable, canonically-sorted set of labels keyed by (source, key)."""
+
+    __slots__ = ("_by_key", "_sorted", "_hash")
+
+    def __init__(self, labels: Iterable[Label] = ()):
+        by_key: Dict[Tuple[str, str], Label] = {}
+        for lbl in labels:
+            by_key[(lbl.source, lbl.key)] = lbl
+        object.__setattr__(self, "_by_key", by_key)
+        object.__setattr__(self, "_sorted", tuple(sorted(by_key.values())))
+        object.__setattr__(self, "_hash", hash(self._sorted))
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def parse(cls, texts: Iterable[str], default_source: str = SOURCE_UNSPEC) -> "Labels":
+        return cls(parse_label(t, default_source) for t in texts)
+
+    @classmethod
+    def from_k8s(cls, kv: Dict[str, str]) -> "Labels":
+        """Pod labels from a k8s-style dict; source forced to ``k8s``."""
+        return cls(Label(SOURCE_K8S, k, v) for k, v in kv.items())
+
+    @classmethod
+    def reserved(cls, name: str) -> "Labels":
+        return cls([Label(SOURCE_RESERVED, name)])
+
+    # -- queries ------------------------------------------------------------
+    def get(self, source: str, key: str) -> Optional[Label]:
+        if source == SOURCE_ANY:
+            # 'any' source: the key under any source (first in canonical order;
+            # use get_all when several sources may carry the same key).
+            matches = self.get_all(source, key)
+            return matches[0] if matches else None
+        return self._by_key.get((source, key))
+
+    def get_all(self, source: str, key: str) -> Tuple[Label, ...]:
+        """All labels matching (source, key); source 'any' spans sources."""
+        if source == SOURCE_ANY:
+            return tuple(l for l in self._sorted if l.key == key)
+        lbl = self._by_key.get((source, key))
+        return (lbl,) if lbl is not None else ()
+
+    def has(self, source: str, key: str) -> bool:
+        return self.get(source, key) is not None
+
+    def sorted_list(self) -> Tuple[Label, ...]:
+        return self._sorted
+
+    def to_strings(self) -> Tuple[str, ...]:
+        return tuple(str(lbl) for lbl in self._sorted)
+
+    def union(self, other: "Labels") -> "Labels":
+        return Labels(list(self._sorted) + list(other.sorted_list()))
+
+    # -- dunder -------------------------------------------------------------
+    def __iter__(self) -> Iterator[Label]:
+        return iter(self._sorted)
+
+    def __len__(self) -> int:
+        return len(self._sorted)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Labels) and self._sorted == other._sorted
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __repr__(self) -> str:
+        return f"Labels({', '.join(self.to_strings())})"

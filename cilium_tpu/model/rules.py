@@ -1,0 +1,315 @@
+"""CNP-compatible rule schema (analog of upstream ``pkg/policy/api``).
+
+The JSON wire format deliberately follows CiliumNetworkPolicy's ``spec``
+closely — ``endpointSelector``, ``ingress``/``egress`` (+ ``ingressDeny`` /
+``egressDeny``), ``fromEndpoints``/``toEndpoints``, ``fromCIDR[Set]`` /
+``toCIDR[Set]``, ``fromEntities``/``toEntities``, ``toPorts`` (with
+``endPort`` ranges and L7 ``rules.http``), ``icmps`` — so that rule documents
+written for upstream Cilium ingest unchanged (SURVEY.md §2: "Keep schema
+~verbatim (JSON-compatible) for rule ingestion").
+
+Out of scope v1 (parsed → rejected with a clear error rather than silently
+ignored): ``toFQDNs``, ``fromRequires``/``toRequires``, L7 kafka/dns.
+``toServices`` is accepted and resolved through a host-side service registry
+(BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.selectors import EndpointSelector
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import normalize_prefix
+
+ENTITY_NAMES = (
+    "all", "world", "host", "remote-node", "cluster", "init", "health",
+    "unmanaged", "kube-apiserver", "ingress",
+)
+
+
+class RuleParseError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# L4 / L7
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class HTTPRule:
+    """L7-lite HTTP rule: exact method (empty = any) + path *prefix*.
+
+    Upstream's PortRuleHTTP.Path is a regex; the L7-lite contract (BASELINE
+    config 4) reduces it to prefix matching on a tokenized header tensor.
+    """
+    method: str = ""
+    path: str = ""
+
+    def __post_init__(self):
+        if self.method and self.method not in C.HTTP_METHOD_IDS:
+            raise RuleParseError(f"unsupported HTTP method {self.method!r}")
+        if len(self.path.encode()) > C.L7_PATH_MAXLEN:
+            raise RuleParseError(
+                f"path prefix longer than L7_PATH_MAXLEN={C.L7_PATH_MAXLEN}")
+
+
+@dataclass(frozen=True)
+class PortProtocol:
+    """One port (or range) + protocol. ``port == 0`` → all ports of proto."""
+    port: int = 0
+    end_port: int = 0  # 0 → single port
+    protocol: str = "ANY"  # TCP | UDP | SCTP | ANY | ICMP | ICMPv6
+
+    def __post_init__(self):
+        if self.protocol not in ("TCP", "UDP", "SCTP", "ANY", "ICMP", "ICMPv6"):
+            raise RuleParseError(f"bad protocol {self.protocol!r}")
+        if not (0 <= self.port <= 65535):
+            raise RuleParseError(f"bad port {self.port}")
+        if self.end_port:
+            if self.port == 0:
+                raise RuleParseError("endPort requires a non-zero port")
+            if not (self.port <= self.end_port <= 65535):
+                raise RuleParseError(
+                    f"bad port range {self.port}-{self.end_port}")
+
+    @property
+    def port_range(self) -> Tuple[int, int]:
+        """Inclusive (lo, hi); (0, 65535) when the port is wildcarded."""
+        if self.port == 0:
+            return (0, 65535)
+        return (self.port, self.end_port or self.port)
+
+    def protocols(self) -> Tuple[int, ...]:
+        """Numeric protocols this PortProtocol expands to."""
+        if self.protocol == "ANY":
+            return C.PORT_PROTOS
+        return (C.PROTO_BY_NAME[self.protocol],)
+
+
+@dataclass(frozen=True)
+class PortRule:
+    ports: Tuple[PortProtocol, ...] = ()
+    http: Tuple[HTTPRule, ...] = ()  # non-empty → L7 redirect semantics
+
+
+@dataclass(frozen=True)
+class ICMPField:
+    family: str = "IPv4"  # IPv4 | IPv6
+    icmp_type: int = 0
+
+    def __post_init__(self):
+        if self.family not in ("IPv4", "IPv6"):
+            raise RuleParseError(f"bad ICMP family {self.family!r}")
+        if not (0 <= self.icmp_type <= 255):
+            raise RuleParseError(f"bad ICMP type {self.icmp_type}")
+
+
+@dataclass(frozen=True)
+class CIDRSelector:
+    """A CIDR (+ optional excepts) peer selector."""
+    cidr: str
+    excepts: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        try:
+            object.__setattr__(self, "cidr", normalize_prefix(self.cidr))
+            object.__setattr__(
+                self, "excepts", tuple(normalize_prefix(e) for e in self.excepts))
+        except ValueError as e:
+            raise RuleParseError(f"bad CIDR: {e}") from e
+
+
+# --------------------------------------------------------------------------- #
+# Rule blocks
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class PeerSpec:
+    """The from*/to* side of one ingress/egress block."""
+    endpoints: Tuple[EndpointSelector, ...] = ()
+    cidrs: Tuple[CIDRSelector, ...] = ()
+    entities: Tuple[str, ...] = ()
+    services: Tuple[EndpointSelector, ...] = ()  # toServices k8s selectors
+
+    @property
+    def is_empty(self) -> bool:
+        return not (self.endpoints or self.cidrs or self.entities or self.services)
+
+
+@dataclass(frozen=True)
+class RuleBlock:
+    """One entry of ingress/egress/ingressDeny/egressDeny."""
+    peer: PeerSpec = field(default_factory=PeerSpec)
+    to_ports: Tuple[PortRule, ...] = ()
+    icmps: Tuple[ICMPField, ...] = ()
+
+
+@dataclass(frozen=True)
+class Rule:
+    endpoint_selector: EndpointSelector
+    ingress: Tuple[RuleBlock, ...] = ()
+    egress: Tuple[RuleBlock, ...] = ()
+    ingress_deny: Tuple[RuleBlock, ...] = ()
+    egress_deny: Tuple[RuleBlock, ...] = ()
+    labels: Labels = field(default_factory=Labels)
+    description: str = ""
+    # Whether each section key was *present* in the source JSON — presence of
+    # an (even empty) section flips default-enforcement for that direction,
+    # exactly like upstream (a CNP with `ingress: []` default-denies ingress).
+    has_ingress_section: bool = False
+    has_egress_section: bool = False
+
+    def selects(self, ep_labels: Labels) -> bool:
+        return self.endpoint_selector.matches(ep_labels)
+
+    @property
+    def enforces_ingress(self) -> bool:
+        return self.has_ingress_section or bool(self.ingress or self.ingress_deny)
+
+    @property
+    def enforces_egress(self) -> bool:
+        return self.has_egress_section or bool(self.egress or self.egress_deny)
+
+
+# --------------------------------------------------------------------------- #
+# Parsing
+# --------------------------------------------------------------------------- #
+_UNSUPPORTED_BLOCK_KEYS = {
+    "toFQDNs": "toFQDNs (FQDN policy) is out of scope v1",
+    "fromRequires": "fromRequires is out of scope v1",
+    "toRequires": "toRequires is out of scope v1",
+}
+
+
+def _parse_port_protocol(obj: Dict) -> PortProtocol:
+    port_raw = obj.get("port", 0)
+    try:
+        port = int(port_raw) if port_raw not in (None, "") else 0
+    except ValueError:
+        raise RuleParseError(
+            f"named ports are not supported (got port={port_raw!r})")
+    try:
+        end_port = int(obj.get("endPort", 0) or 0)
+    except (TypeError, ValueError):
+        raise RuleParseError(f"bad endPort {obj.get('endPort')!r}")
+    return PortProtocol(
+        port=port,
+        end_port=end_port,
+        protocol=obj.get("protocol", "ANY") or "ANY",
+    )
+
+
+def _parse_port_rule(obj: Dict) -> PortRule:
+    ports = tuple(_parse_port_protocol(p) for p in obj.get("ports") or [])
+    http: Tuple[HTTPRule, ...] = ()
+    l7 = obj.get("rules") or {}
+    for key in l7:
+        if key == "http":
+            http = tuple(
+                HTTPRule(method=h.get("method", "") or "",
+                         path=h.get("path", "") or "")
+                for h in l7["http"] or []
+            )
+        else:
+            raise RuleParseError(f"L7 rule kind {key!r} not supported (L7-lite is http-only)")
+    return PortRule(ports=ports, http=http)
+
+
+def _parse_block(obj: Dict, direction: str, deny: bool) -> RuleBlock:
+    for bad, msg in _UNSUPPORTED_BLOCK_KEYS.items():
+        if bad in obj:
+            raise RuleParseError(msg)
+    pfx = "from" if direction == "ingress" else "to"
+    endpoints = tuple(EndpointSelector.from_json(s)
+                      for s in obj.get(f"{pfx}Endpoints") or [])
+    cidrs: List[CIDRSelector] = [CIDRSelector(cidr=c)
+                                 for c in obj.get(f"{pfx}CIDR") or []]
+    for cs in obj.get(f"{pfx}CIDRSet") or []:
+        cidrs.append(CIDRSelector(cidr=cs["cidr"],
+                                  excepts=tuple(cs.get("except") or ())))
+    entities = tuple(obj.get(f"{pfx}Entities") or ())
+    for ent in entities:
+        if ent not in ENTITY_NAMES:
+            raise RuleParseError(f"unknown entity {ent!r}")
+    services: Tuple[EndpointSelector, ...] = ()
+    if direction == "egress":
+        svc_sels = []
+        for svc in obj.get("toServices") or []:
+            if "k8sServiceSelector" in svc:
+                ks_sel = svc["k8sServiceSelector"]
+                if "selector" not in ks_sel:
+                    raise RuleParseError(
+                        "toServices k8sServiceSelector requires a 'selector'")
+                sel = EndpointSelector.from_json(ks_sel["selector"])
+                if ks_sel.get("namespace"):
+                    sel = EndpointSelector(
+                        match_labels=sel.match_labels + (
+                            ("k8s:io.kubernetes.service.namespace",
+                             ks_sel["namespace"]),),
+                        match_expressions=sel.match_expressions)
+                svc_sels.append(sel)
+            elif "k8sService" in svc:
+                ks = svc["k8sService"]
+                if not ks.get("serviceName"):
+                    raise RuleParseError("toServices k8sService requires serviceName")
+                svc_sels.append(EndpointSelector.from_labels({
+                    "k8s:io.kubernetes.service.name": ks["serviceName"],
+                    "k8s:io.kubernetes.service.namespace": ks.get("namespace", "default"),
+                }))
+            else:
+                raise RuleParseError(
+                    "toServices entry needs k8sService or k8sServiceSelector")
+        services = tuple(svc_sels)
+    to_ports = tuple(_parse_port_rule(p) for p in obj.get("toPorts") or [])
+    icmps: List[ICMPField] = []
+    for icmp_rule in obj.get("icmps") or []:
+        for f in icmp_rule.get("fields") or []:
+            if "type" not in f:
+                raise RuleParseError("icmps field requires 'type'")
+            try:
+                icmp_type = int(f["type"])
+            except (TypeError, ValueError):
+                raise RuleParseError(f"bad ICMP type {f['type']!r}")
+            icmps.append(ICMPField(family=f.get("family", "IPv4"),
+                                   icmp_type=icmp_type))
+    if deny:
+        for pr in to_ports:
+            if pr.http:
+                raise RuleParseError("deny rules cannot carry L7 rules")
+    return RuleBlock(
+        peer=PeerSpec(endpoints=endpoints, cidrs=tuple(cidrs),
+                      entities=entities, services=services),
+        to_ports=to_ports,
+        icmps=tuple(icmps),
+    )
+
+
+def parse_rule(obj: Dict) -> Rule:
+    if "endpointSelector" not in obj:
+        raise RuleParseError("rule missing endpointSelector")
+    return Rule(
+        endpoint_selector=EndpointSelector.from_json(obj["endpointSelector"]),
+        ingress=tuple(_parse_block(b, "ingress", False)
+                      for b in obj.get("ingress") or []),
+        egress=tuple(_parse_block(b, "egress", False)
+                     for b in obj.get("egress") or []),
+        ingress_deny=tuple(_parse_block(b, "ingress", True)
+                           for b in obj.get("ingressDeny") or []),
+        egress_deny=tuple(_parse_block(b, "egress", True)
+                          for b in obj.get("egressDeny") or []),
+        labels=Labels.parse(obj.get("labels") or []),
+        description=obj.get("description", ""),
+        has_ingress_section=("ingress" in obj or "ingressDeny" in obj),
+        has_egress_section=("egress" in obj or "egressDeny" in obj),
+    )
+
+
+def parse_rules(docs: Sequence[Dict] | str) -> List[Rule]:
+    """Parse a list of rule dicts, or a JSON string holding one."""
+    if isinstance(docs, str):
+        docs = json.loads(docs)
+    if isinstance(docs, dict):
+        docs = [docs]
+    return [parse_rule(d) for d in docs]

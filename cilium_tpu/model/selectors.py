@@ -1,0 +1,98 @@
+"""Endpoint selectors (analog of upstream ``pkg/policy/api.EndpointSelector``).
+
+Supports k8s-style ``matchLabels`` and ``matchExpressions`` (In / NotIn /
+Exists / DoesNotExist). Selector keys may carry an explicit source prefix
+(``k8s:app``, ``reserved:world``, ``any:app``); bare keys default to ``any``,
+matching the key under any label source — mirroring upstream's behavior of
+prefixing CNP selector keys and treating ``any.`` as source-wildcard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu.model.labels import Labels, SOURCE_ANY
+
+
+def _split_key(key: str) -> Tuple[str, str]:
+    if ":" in key:
+        source, k = key.split(":", 1)
+        return source, k
+    return SOURCE_ANY, key
+
+
+@dataclass(frozen=True)
+class MatchExpression:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Labels) -> bool:
+        source, key = _split_key(self.key)
+        lbls = labels.get_all(source, key)
+        if self.operator == "In":
+            return any(l.value in self.values for l in lbls)
+        if self.operator == "NotIn":
+            return all(l.value not in self.values for l in lbls)
+        if self.operator == "Exists":
+            return bool(lbls)
+        if self.operator == "DoesNotExist":
+            return not lbls
+        raise ValueError(f"unknown matchExpressions operator {self.operator!r}")
+
+
+@dataclass(frozen=True)
+class EndpointSelector:
+    """A label selector. The empty selector matches every endpoint/identity."""
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    match_expressions: Tuple[MatchExpression, ...] = ()
+
+    @classmethod
+    def from_json(cls, obj: Optional[Dict]) -> "EndpointSelector":
+        if obj is None:
+            return cls()
+        ml = tuple(sorted((k, v) for k, v in (obj.get("matchLabels") or {}).items()))
+        mes: List[MatchExpression] = []
+        for e in obj.get("matchExpressions") or []:
+            mes.append(MatchExpression(
+                key=e["key"],
+                operator=e["operator"],
+                values=tuple(e.get("values") or ()),
+            ))
+        return cls(match_labels=ml, match_expressions=tuple(mes))
+
+    @classmethod
+    def from_labels(cls, kv: Dict[str, str]) -> "EndpointSelector":
+        return cls(match_labels=tuple(sorted(kv.items())))
+
+    def matches(self, labels: Labels) -> bool:
+        for key, want in self.match_labels:
+            source, k = _split_key(key)
+            if not any(l.value == want for l in labels.get_all(source, k)):
+                return False
+        for expr in self.match_expressions:
+            if not expr.matches(labels):
+                return False
+        return True
+
+    @property
+    def is_wildcard(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+    def to_json(self) -> Dict:
+        out: Dict = {}
+        if self.match_labels:
+            out["matchLabels"] = {k: v for k, v in self.match_labels}
+        if self.match_expressions:
+            out["matchExpressions"] = [
+                {"key": e.key, "operator": e.operator,
+                 **({"values": list(e.values)} if e.values else {})}
+                for e in self.match_expressions
+            ]
+        return out
+
+    def __str__(self) -> str:
+        import json
+        return json.dumps(self.to_json(), sort_keys=True)

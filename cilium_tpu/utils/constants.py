@@ -1,0 +1,185 @@
+"""Shared constants: reserved identities, protocols, verdicts, drop reasons, CT.
+
+Numbering follows upstream Cilium's public, documented values where those are
+well-known (reserved identities, local-identity scope bit). Drop-reason numbers
+are this framework's own enum — the *names* mirror upstream's
+``bpf/lib/drop.h`` reason names, but the reference mount was empty (SURVEY.md
+§0) so no numeric values are claimed as read-from-source.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# --------------------------------------------------------------------------- #
+# Reserved security identities (upstream: pkg/identity/reserved, numericidentity)
+# --------------------------------------------------------------------------- #
+IDENTITY_UNKNOWN = 0
+IDENTITY_HOST = 1
+IDENTITY_WORLD = 2
+IDENTITY_UNMANAGED = 3
+IDENTITY_HEALTH = 4
+IDENTITY_INIT = 5
+IDENTITY_REMOTE_NODE = 6
+IDENTITY_KUBE_APISERVER = 7
+IDENTITY_INGRESS = 8
+
+RESERVED_IDENTITIES = {
+    "unknown": IDENTITY_UNKNOWN,
+    "host": IDENTITY_HOST,
+    "world": IDENTITY_WORLD,
+    "unmanaged": IDENTITY_UNMANAGED,
+    "health": IDENTITY_HEALTH,
+    "init": IDENTITY_INIT,
+    "remote-node": IDENTITY_REMOTE_NODE,
+    "kube-apiserver": IDENTITY_KUBE_APISERVER,
+    "ingress": IDENTITY_INGRESS,
+}
+RESERVED_IDENTITY_NAMES = {v: k for k, v in RESERVED_IDENTITIES.items()}
+
+# First identity id available for cluster-scope (label-derived) identities.
+CLUSTER_IDENTITY_BASE = 256
+# Cluster-scope identities fit in 16 bits upstream.
+CLUSTER_IDENTITY_MAX = 65535
+
+# Node-local identities (CIDR-derived) carry the local scope bit
+# (upstream: identity.IdentityScopeLocal == 1 << 24).
+LOCAL_IDENTITY_SCOPE = 1 << 24
+
+# Wildcard identity in MapState / policymap keys (matches any remote identity).
+IDENTITY_ANY = 0
+
+# --------------------------------------------------------------------------- #
+# Protocols
+# --------------------------------------------------------------------------- #
+PROTO_ANY = 0
+PROTO_ICMP = 1
+PROTO_TCP = 6
+PROTO_UDP = 17
+PROTO_ICMP6 = 58
+PROTO_SCTP = 132
+
+PROTO_NAMES = {
+    PROTO_ANY: "ANY",
+    PROTO_ICMP: "ICMP",
+    PROTO_TCP: "TCP",
+    PROTO_UDP: "UDP",
+    PROTO_ICMP6: "ICMPv6",
+    PROTO_SCTP: "SCTP",
+}
+PROTO_BY_NAME = {v: k for k, v in PROTO_NAMES.items()}
+
+# Protocols that carry L4 ports.
+PORT_PROTOS = (PROTO_TCP, PROTO_UDP, PROTO_SCTP)
+
+# Dense "proto family" index used by the compiled tensors: ports only make
+# sense for TCP/UDP/SCTP; ICMP type is carried in the port field (upstream CT
+# does the same trick with ICMP type/code in the port slots).
+PROTO_FAMILY_TCP = 0
+PROTO_FAMILY_UDP = 1
+PROTO_FAMILY_SCTP = 2
+PROTO_FAMILY_ICMP = 3   # ICMP and ICMPv6
+PROTO_FAMILY_OTHER = 4
+N_PROTO_FAMILIES = 5
+
+
+def proto_family(proto: int, is_ipv6: bool = False) -> int:
+    if proto == PROTO_TCP:
+        return PROTO_FAMILY_TCP
+    if proto == PROTO_UDP:
+        return PROTO_FAMILY_UDP
+    if proto == PROTO_SCTP:
+        return PROTO_FAMILY_SCTP
+    if proto in (PROTO_ICMP, PROTO_ICMP6):
+        return PROTO_FAMILY_ICMP
+    return PROTO_FAMILY_OTHER
+
+
+# --------------------------------------------------------------------------- #
+# Directions (relative to the local endpoint, as in per-endpoint policymaps)
+# --------------------------------------------------------------------------- #
+DIR_EGRESS = 0   # traffic leaving the endpoint
+DIR_INGRESS = 1  # traffic entering the endpoint
+N_DIRECTIONS = 2
+
+DIR_NAMES = {DIR_EGRESS: "egress", DIR_INGRESS: "ingress"}
+
+# --------------------------------------------------------------------------- #
+# Verdict codes (dense tensor cell values; low 2 bits = decision)
+# --------------------------------------------------------------------------- #
+VERDICT_MISS = 0       # no matching entry: default-deny if enforced else allow
+VERDICT_ALLOW = 1
+VERDICT_DENY = 2
+VERDICT_REDIRECT = 3   # L7 redirect; upper bits carry the L7 ruleset id
+
+VERDICT_DECISION_MASK = 0x3
+VERDICT_L7_SHIFT = 2   # l7 ruleset id stored in bits [2..15] of the uint16 cell
+
+
+def verdict_cell(decision: int, l7_id: int = 0) -> int:
+    return (l7_id << VERDICT_L7_SHIFT) | decision
+
+
+# --------------------------------------------------------------------------- #
+# Final per-packet forward decision + drop reasons.
+# Names mirror upstream bpf/lib/drop.h; numbers are ours (see module docstring).
+# --------------------------------------------------------------------------- #
+class DropReason(enum.IntEnum):
+    OK = 0                    # forwarded
+    POLICY = 130              # default deny: enforced direction, no matching rule
+    POLICY_DENY = 133         # explicit deny rule matched
+    POLICY_L7 = 180           # L7-lite rules matched none of the request tokens
+    CT_INVALID = 134          # malformed / untrackable (e.g. bad header record)
+    INVALID_IDENTITY = 135    # ipcache produced no usable identity
+    UNSUPPORTED_PROTO = 136
+
+
+# --------------------------------------------------------------------------- #
+# Conntrack (upstream: bpf/lib/conntrack.h, pkg/maps/ctmap)
+# --------------------------------------------------------------------------- #
+class CTStatus(enum.IntEnum):
+    NEW = 0
+    ESTABLISHED = 1
+    REPLY = 2
+    # RELATED (ICMP errors referencing an inner tuple) is deliberately not
+    # implemented in v1; ICMP echo is tracked as its own flow instead.
+
+
+# Lifetimes in seconds (upstream defaults: CT_SYN_TIMEOUT 60s,
+# CT_ESTABLISHED_LIFETIME_TCP 21600s, nonTCP 60s, CT_CLOSE_TIMEOUT 10s).
+CT_LIFETIME_SYN = 60
+CT_LIFETIME_TCP = 21600
+CT_LIFETIME_NONTCP = 60
+CT_LIFETIME_CLOSE = 10
+
+# CT entry flag bits.
+CT_FLAG_SEEN_NON_SYN = 1 << 0
+CT_FLAG_TX_CLOSING = 1 << 1
+CT_FLAG_RX_CLOSING = 1 << 2
+
+# TCP header flag bits (standard wire format, low byte).
+TCP_FIN = 0x01
+TCP_SYN = 0x02
+TCP_RST = 0x04
+TCP_PSH = 0x08
+TCP_ACK = 0x10
+
+# --------------------------------------------------------------------------- #
+# Policy enforcement modes (upstream: option.Config.EnablePolicy —
+# "default" | "always" | "never"; these change verdicts, so they are part of
+# the parity contract)
+# --------------------------------------------------------------------------- #
+ENFORCEMENT_DEFAULT = "default"
+ENFORCEMENT_ALWAYS = "always"
+ENFORCEMENT_NEVER = "never"
+ENFORCEMENT_MODES = (ENFORCEMENT_DEFAULT, ENFORCEMENT_ALWAYS, ENFORCEMENT_NEVER)
+
+# --------------------------------------------------------------------------- #
+# L7-lite (config 4): tokenized HTTP method/path-prefix matching
+# --------------------------------------------------------------------------- #
+HTTP_METHODS = (
+    "GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH", "TRACE", "CONNECT",
+)
+HTTP_METHOD_IDS = {m: i for i, m in enumerate(HTTP_METHODS)}
+HTTP_METHOD_ANY = 255
+L7_PATH_MAXLEN = 64

@@ -1,0 +1,65 @@
+"""IP address / prefix helpers.
+
+All addresses are normalized to 16 bytes: IPv6 verbatim, IPv4 as the
+v4-mapped form ``::ffff:a.b.c.d``. This lets a single 16-level stride-8 LPM
+trie serve both families (SURVEY.md §5 "long-context" analog: LPM over 100k
+prefixes as multi-level stride tables), with a precomputed 4-level fast path
+for pure-IPv4 batches.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Tuple
+
+V4_MAPPED_PREFIX = b"\x00" * 10 + b"\xff\xff"
+
+
+def parse_addr(text: str) -> Tuple[bytes, bool]:
+    """Parse an address string → (16-byte normalized form, is_ipv6)."""
+    addr = ipaddress.ip_address(text)
+    if addr.version == 4:
+        return V4_MAPPED_PREFIX + addr.packed, False
+    return addr.packed, True
+
+
+def parse_prefix(text: str) -> Tuple[bytes, int, bool]:
+    """Parse a CIDR string → (16-byte normalized network address, normalized
+    prefix length in the 128-bit space, is_ipv6).
+
+    IPv4 ``/p`` becomes ``/(96+p)`` in the v4-mapped space.
+    """
+    net = ipaddress.ip_network(text, strict=False)
+    if net.version == 4:
+        return V4_MAPPED_PREFIX + net.network_address.packed, 96 + net.prefixlen, False
+    return net.network_address.packed, net.prefixlen, True
+
+
+def normalize_prefix(text: str) -> str:
+    """Canonical string form of a CIDR (host bits cleared)."""
+    return str(ipaddress.ip_network(text, strict=False))
+
+
+def addr_to_words(addr16: bytes) -> Tuple[int, int, int, int]:
+    """16-byte address → four big-endian uint32 words (device layout)."""
+    return (
+        int.from_bytes(addr16[0:4], "big"),
+        int.from_bytes(addr16[4:8], "big"),
+        int.from_bytes(addr16[8:12], "big"),
+        int.from_bytes(addr16[12:16], "big"),
+    )
+
+
+def words_to_addr(words) -> bytes:
+    return b"".join(int(w).to_bytes(4, "big") for w in words)
+
+
+def addr_to_str(addr16: bytes) -> str:
+    """Render a normalized 16-byte address, un-mapping v4."""
+    if addr16[:12] == V4_MAPPED_PREFIX:
+        return str(ipaddress.IPv4Address(addr16[12:]))
+    return str(ipaddress.IPv6Address(addr16))
+
+
+def is_v4_mapped(addr16: bytes) -> bool:
+    return addr16[:12] == V4_MAPPED_PREFIX

@@ -1,0 +1,14 @@
+"""Test config: force JAX onto CPU with 8 fake devices BEFORE jax import.
+
+This is the standard JAX idiom for testing pmap/shard_map sharding logic
+without TPU hardware (SURVEY.md §4: the control-plane-fixture-replay analog).
+Must run before anything imports jax, hence conftest at collection time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

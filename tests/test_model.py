@@ -1,0 +1,223 @@
+"""Unit tests for the model layer (labels/selectors/rules/identity/ipcache)."""
+
+import pytest
+
+from cilium_tpu.model.labels import Label, Labels, parse_label
+from cilium_tpu.model.selectors import EndpointSelector
+from cilium_tpu.model.rules import (
+    CIDRSelector, PortProtocol, RuleParseError, parse_rule, parse_rules,
+)
+from cilium_tpu.model.identity import IdentityAllocator, cidr_identity_labels
+from cilium_tpu.model.ipcache import IPCache
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import addr_to_words, parse_addr, parse_prefix, addr_to_str
+
+
+class TestLabels:
+    def test_parse(self):
+        lbl = parse_label("k8s:app=web")
+        assert lbl == Label("k8s", "app", "web")
+        assert parse_label("reserved:world") == Label("reserved", "world", "")
+        assert parse_label("app=web") == Label("unspec", "app", "web")
+
+    def test_sorted_canonical_and_hashable(self):
+        a = Labels.parse(["k8s:app=web", "k8s:tier=fe"])
+        b = Labels.parse(["k8s:tier=fe", "k8s:app=web"])
+        assert a == b and hash(a) == hash(b)
+        assert a.to_strings() == ("k8s:app=web", "k8s:tier=fe")
+
+    def test_any_source_lookup(self):
+        lbls = Labels.parse(["k8s:app=web"])
+        assert lbls.get("any", "app").value == "web"
+        assert lbls.get("k8s", "app").value == "web"
+        assert lbls.get("reserved", "app") is None
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = EndpointSelector.from_json({"matchLabels": {"app": "web"}})
+        assert sel.matches(Labels.parse(["k8s:app=web"]))
+        assert not sel.matches(Labels.parse(["k8s:app=db"]))
+
+    def test_source_prefixed_key(self):
+        sel = EndpointSelector.from_json({"matchLabels": {"reserved:world": ""}})
+        assert sel.matches(Labels.reserved("world"))
+        assert not sel.matches(Labels.parse(["k8s:world="]))
+
+    def test_match_expressions(self):
+        sel = EndpointSelector.from_json({"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["web", "api"]},
+            {"key": "banned", "operator": "DoesNotExist"},
+        ]})
+        assert sel.matches(Labels.parse(["k8s:app=api"]))
+        assert not sel.matches(Labels.parse(["k8s:app=api", "k8s:banned=1"]))
+        assert not sel.matches(Labels.parse(["k8s:app=db"]))
+
+    def test_wildcard(self):
+        sel = EndpointSelector.from_json({})
+        assert sel.is_wildcard
+        assert sel.matches(Labels())
+
+    def test_any_source_spans_duplicate_keys(self):
+        # same key under two sources: 'any' must consider all of them
+        lbls = Labels.parse(["cidr:app=x", "k8s:app=web"])
+        assert EndpointSelector.from_json(
+            {"matchLabels": {"app": "web"}}).matches(lbls)
+        assert EndpointSelector.from_json({"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["web"]}]}).matches(lbls)
+        assert not EndpointSelector.from_json({"matchExpressions": [
+            {"key": "app", "operator": "NotIn", "values": ["web"]}]}).matches(lbls)
+
+    def test_port_zero_with_endport_rejected(self):
+        with pytest.raises(RuleParseError):
+            PortProtocol(port=0, end_port=90, protocol="TCP")
+
+
+class TestRules:
+    def test_parse_basic_cnp(self):
+        rule = parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"role": "fe"}}],
+                "toPorts": [{"ports": [
+                    {"port": "80", "protocol": "TCP"},
+                    {"port": "8080", "endPort": 8090, "protocol": "TCP"},
+                ]}],
+            }],
+        })
+        assert rule.enforces_ingress and not rule.enforces_egress
+        pr = rule.ingress[0].to_ports[0]
+        assert pr.ports[0].port_range == (80, 80)
+        assert pr.ports[1].port_range == (8080, 8090)
+
+    def test_empty_section_flips_enforcement(self):
+        rule = parse_rule({"endpointSelector": {}, "ingress": []})
+        assert rule.enforces_ingress
+
+    def test_cidrset_with_except(self):
+        rule = parse_rule({
+            "endpointSelector": {},
+            "egress": [{"toCIDRSet": [
+                {"cidr": "10.0.0.0/8", "except": ["10.1.0.0/16"]}]}],
+        })
+        cs = rule.egress[0].peer.cidrs[0]
+        assert cs.cidr == "10.0.0.0/8" and cs.excepts == ("10.1.0.0/16",)
+
+    def test_proto_any_expands(self):
+        assert PortProtocol(port=53, protocol="ANY").protocols() == C.PORT_PROTOS
+
+    def test_l7_http(self):
+        rule = parse_rule({
+            "endpointSelector": {},
+            "ingress": [{"toPorts": [{
+                "ports": [{"port": "80", "protocol": "TCP"}],
+                "rules": {"http": [{"method": "GET", "path": "/api"}]},
+            }]}],
+        })
+        assert rule.ingress[0].to_ports[0].http[0].method == "GET"
+
+    def test_rejects_out_of_scope(self):
+        with pytest.raises(RuleParseError):
+            parse_rule({"endpointSelector": {},
+                        "egress": [{"toFQDNs": [{"matchName": "x.com"}]}]})
+        with pytest.raises(RuleParseError):
+            parse_rule({"endpointSelector": {},
+                        "ingressDeny": [{"toPorts": [{
+                            "ports": [{"port": "80", "protocol": "TCP"}],
+                            "rules": {"http": [{"path": "/"}]}}]}]})
+
+    def test_entities(self):
+        rule = parse_rule({"endpointSelector": {},
+                           "egress": [{"toEntities": ["world", "cluster"]}]})
+        assert rule.egress[0].peer.entities == ("world", "cluster")
+        with pytest.raises(RuleParseError):
+            parse_rule({"endpointSelector": {},
+                        "egress": [{"toEntities": ["galaxy"]}]})
+
+
+class TestIdentity:
+    def test_reserved_preallocated(self):
+        alloc = IdentityAllocator()
+        assert alloc.get(C.IDENTITY_WORLD).labels == Labels.reserved("world")
+
+    def test_idempotent_cluster_alloc(self):
+        alloc = IdentityAllocator()
+        a = alloc.allocate(Labels.parse(["k8s:app=web"]))
+        b = alloc.allocate(Labels.parse(["k8s:app=web"]))
+        assert a.id == b.id >= C.CLUSTER_IDENTITY_BASE
+
+    def test_cidr_identity_is_local_scope(self):
+        alloc = IdentityAllocator()
+        ident = alloc.allocate_cidr("10.0.0.0/8")
+        assert ident.id & C.LOCAL_IDENTITY_SCOPE
+        assert ident.is_cidr
+        # CIDR identities carry reserved:world (world-scoped)
+        assert ident.labels.has("reserved", "world")
+
+    def test_release_refcounted(self):
+        alloc = IdentityAllocator()
+        a = alloc.allocate(Labels.parse(["k8s:app=web"]))
+        alloc.allocate(Labels.parse(["k8s:app=web"]))
+        assert not alloc.release(a)
+        assert alloc.release(a)
+        assert alloc.get(a.id) is None
+
+    def test_observer_notified(self):
+        alloc = IdentityAllocator()
+        events = []
+        alloc.add_observer(lambda add, rem: events.append((len(add), len(rem))),
+                           replay=False)
+        ident = alloc.allocate(Labels.parse(["k8s:app=web"]))
+        alloc.release(ident)
+        assert events == [(1, 0), (0, 1)]
+
+    def test_export_restore_stable(self):
+        alloc = IdentityAllocator()
+        a = alloc.allocate(Labels.parse(["k8s:app=web"]))
+        state = alloc.export_state()
+        alloc2 = IdentityAllocator()
+        alloc2.restore_state(state)
+        assert alloc2.lookup_by_labels(Labels.parse(["k8s:app=web"])).id == a.id
+        b = alloc2.allocate(Labels.parse(["k8s:app=db"]))
+        assert b.id == a.id + 1
+
+
+class TestIPCache:
+    def test_lpm_most_specific_wins(self):
+        cache = IPCache()
+        cache.upsert("10.0.0.0/8", 100)
+        cache.upsert("10.1.0.0/16", 200)
+        cache.upsert("10.1.2.3/32", 300)
+        assert cache.lookup("10.2.0.1") == 100
+        assert cache.lookup("10.1.9.9") == 200
+        assert cache.lookup("10.1.2.3") == 300
+
+    def test_miss_is_world(self):
+        cache = IPCache()
+        assert cache.lookup("8.8.8.8") == C.IDENTITY_WORLD
+
+    def test_family_separation(self):
+        cache = IPCache()
+        cache.upsert("::/0", 500)
+        cache.upsert("0.0.0.0/0", 600)
+        assert cache.lookup("1.2.3.4") == 600
+        assert cache.lookup("2001:db8::1") == 500
+
+    def test_revision_bumps(self):
+        cache = IPCache()
+        r0 = cache.revision
+        cache.upsert("10.0.0.0/8", 1)
+        assert cache.revision == r0 + 1
+
+
+class TestIPUtils:
+    def test_v4_mapped(self):
+        addr, is_v6 = parse_addr("1.2.3.4")
+        assert not is_v6
+        assert addr_to_str(addr) == "1.2.3.4"
+        assert addr_to_words(addr) == (0, 0, 0xFFFF, 0x01020304)
+
+    def test_prefix_normalization(self):
+        net, plen, is_v6 = parse_prefix("10.1.2.3/16")
+        assert plen == 96 + 16 and not is_v6
+        assert addr_to_str(net) == "10.1.0.0"
