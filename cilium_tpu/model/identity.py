@@ -51,10 +51,23 @@ class Identity:
 
 
 def cidr_identity_labels(prefix: str) -> Labels:
-    """Labels of a CIDR-derived identity: ``cidr:<prefix>`` + ``reserved:world``
-    (CIDR identities are world-scoped in upstream)."""
-    prefix = normalize_prefix(prefix)
-    return Labels([Label(SOURCE_CIDR, prefix), Label(SOURCE_RESERVED, "world")])
+    """Labels of a CIDR-derived identity.
+
+    Includes one ``cidr:`` label for the prefix itself AND every *parent*
+    prefix, plus ``reserved:world`` (CIDR identities are world-scoped). The
+    parent labels are what make CIDR policy composition work: a rule allowing
+    ``10.0.0.0/8`` compiles to a selector on label ``cidr:10.0.0.0/8``, and an
+    IP that LPM-resolves to a *narrower* identity (say ``10.1.0.0/16``,
+    created by some other rule) still matches because the /16 identity carries
+    the /8 parent label — mirroring upstream's per-prefix-length CIDR labels.
+    """
+    import ipaddress
+    net = ipaddress.ip_network(normalize_prefix(prefix), strict=False)
+    labels: List[Label] = [Label(SOURCE_RESERVED, "world")]
+    for plen in range(net.prefixlen, -1, -1):
+        parent = net.supernet(new_prefix=plen) if plen < net.prefixlen else net
+        labels.append(Label(SOURCE_CIDR, str(parent)))
+    return Labels(labels)
 
 
 # Observer signature: (added: [Identity], removed: [Identity]) -> None

@@ -1,0 +1,59 @@
+"""Host-side service registry (thin analog of upstream ``pkg/service`` /
+k8s Service watchers), just enough to resolve ``toServices`` rules
+(BASELINE config 3): a service = name/namespace + labels + backend IPs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Tuple
+
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.selectors import EndpointSelector
+
+
+@dataclass(frozen=True)
+class Service:
+    name: str
+    namespace: str
+    backends: Tuple[str, ...]          # backend IPs (pod or external)
+    extra_labels: Tuple[Tuple[str, str], ...] = ()
+
+    @property
+    def labels(self) -> Labels:
+        base = {
+            "k8s:io.kubernetes.service.name": self.name,
+            "k8s:io.kubernetes.service.namespace": self.namespace,
+        }
+        base.update({k: v for k, v in self.extra_labels})
+        return Labels.parse([f"{k}={v}" if v else k for k, v in base.items()])
+
+
+class ServiceRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._services: Dict[Tuple[str, str], Service] = {}
+        self._observers: List[Callable[[], None]] = []
+
+    def add_observer(self, obs: Callable[[], None]) -> None:
+        self._observers.append(obs)
+
+    def upsert(self, svc: Service) -> None:
+        with self._lock:
+            self._services[(svc.namespace, svc.name)] = svc
+        for obs in list(self._observers):
+            obs()
+
+    def delete(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            ok = self._services.pop((namespace, name), None) is not None
+        if ok:
+            for obs in list(self._observers):
+                obs()
+        return ok
+
+    def match(self, selector: EndpointSelector) -> List[Service]:
+        with self._lock:
+            return [svc for svc in self._services.values()
+                    if selector.matches(svc.labels)]

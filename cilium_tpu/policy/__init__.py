@@ -1,0 +1,17 @@
+"""Policy engine: Repository + SelectorCache + MapState (analog of upstream
+``pkg/policy``). Its output — per-endpoint, per-direction MapState — is the
+input of the tensor compiler (``cilium_tpu/compile``), exactly as upstream's
+MapState is the desired contents of the per-endpoint policymap.
+"""
+
+from cilium_tpu.policy.selectorcache import SelectorCache, entity_selectors
+from cilium_tpu.policy.mapstate import MapState, MapStateEntry, MapStateKey
+from cilium_tpu.policy.repository import (
+    Repository, EndpointPolicy, DirectionPolicy, PolicyContext,
+)
+
+__all__ = [
+    "SelectorCache", "entity_selectors",
+    "MapState", "MapStateEntry", "MapStateKey",
+    "Repository", "EndpointPolicy", "DirectionPolicy", "PolicyContext",
+]

@@ -1,0 +1,153 @@
+"""MapState: the desired per-endpoint, per-direction verdict state
+(analog of upstream ``pkg/policy`` MapState / ``pkg/maps/policymap`` contents
+— SURVEY.md §2 calls this "the central artifact").
+
+A MapState is a set of entries keyed ``(identity, proto, port_lo, port_hi)``
+with identity 0 = wildcard-ANY and proto 0 = any protocol. Values carry
+allow/deny (+ optional L7-lite http rule set). Two consumers:
+
+- the **oracle** (and `policy trace` CLI) evaluate the sparse entries with the
+  precedence ladder in :func:`MapState.lookup` — the semantic contract;
+- the **tensor compiler** resolves that same ladder *at compile time* into a
+  dense ``verdict[id_class, port_class]`` tensor, so the device does gathers,
+  not ladder walks. Parity between the two paths is test-enforced.
+
+Precedence contract (matching upstream's documented semantics):
+1. any matching DENY entry denies, regardless of specificity;
+2. otherwise the most specific matching ALLOW wins (identity-specific over
+   wildcard, proto/port-specific over wild, narrower port range over wider);
+3. no match → default deny when the direction is enforced, else allow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from cilium_tpu.model.rules import HTTPRule
+from cilium_tpu.utils import constants as C
+
+PORT_WILDCARD: Tuple[int, int] = (0, 65535)
+
+
+@dataclass(frozen=True, order=True)
+class MapStateKey:
+    identity: int          # remote security identity; 0 == ANY
+    proto: int             # 0 == any protocol
+    port_lo: int = 0       # inclusive; for ICMP this is the ICMP type
+    port_hi: int = 65535   # inclusive
+
+    def __post_init__(self):
+        if self.proto == C.PROTO_ANY and (self.port_lo, self.port_hi) != PORT_WILDCARD:
+            raise ValueError("proto-wildcard entries must have wildcard ports")
+        if not (0 <= self.port_lo <= self.port_hi <= 65535):
+            raise ValueError(f"bad port range {self.port_lo}-{self.port_hi}")
+
+    @property
+    def is_port_wild(self) -> bool:
+        return (self.port_lo, self.port_hi) == PORT_WILDCARD
+
+    def covers(self, remote_id: int, proto: int, dport: int) -> bool:
+        if self.identity != C.IDENTITY_ANY and self.identity != remote_id:
+            return False
+        if self.proto != C.PROTO_ANY and self.proto != proto:
+            return False
+        return self.port_lo <= dport <= self.port_hi
+
+    def specificity(self) -> int:
+        """Ladder rank: id-specific(4) + proto-specific(2) + port-specific(1)."""
+        return ((self.identity != C.IDENTITY_ANY) * 4
+                + (self.proto != C.PROTO_ANY) * 2
+                + (not self.is_port_wild) * 1)
+
+
+@dataclass(frozen=True)
+class MapStateEntry:
+    deny: bool = False
+    # None → plain L4 allow; frozenset of HTTPRule → L7-lite redirect.
+    l7_rules: Optional[FrozenSet[HTTPRule]] = None
+    derived_from: Tuple[str, ...] = ()
+
+    @property
+    def is_redirect(self) -> bool:
+        return not self.deny and self.l7_rules is not None
+
+
+def _merge(old: MapStateEntry, new: MapStateEntry) -> MapStateEntry:
+    """Merge two contributions to the same key.
+
+    deny wins; else a plain allow shadows an L7 allow (the wider permission);
+    else union the L7 rule sets.
+    """
+    if old.deny or new.deny:
+        winner = old if old.deny else new
+        other = new if old.deny else old
+        return replace(winner, derived_from=winner.derived_from + other.derived_from)
+    derived = old.derived_from + new.derived_from
+    if old.l7_rules is None or new.l7_rules is None:
+        return MapStateEntry(deny=False, l7_rules=None, derived_from=derived)
+    return MapStateEntry(deny=False, l7_rules=old.l7_rules | new.l7_rules,
+                         derived_from=derived)
+
+
+@dataclass(frozen=True)
+class LookupResult:
+    decision: int                      # C.VERDICT_{MISS,ALLOW,DENY,REDIRECT}
+    key: Optional[MapStateKey] = None
+    entry: Optional[MapStateEntry] = None
+
+
+class MapState:
+    """Mutable builder + queryable container of MapState entries."""
+
+    def __init__(self):
+        self._entries: Dict[MapStateKey, MapStateEntry] = {}
+
+    # -- build --------------------------------------------------------------
+    def add(self, key: MapStateKey, entry: MapStateEntry) -> None:
+        old = self._entries.get(key)
+        self._entries[key] = _merge(old, entry) if old is not None else entry
+
+    # -- query --------------------------------------------------------------
+    def lookup(self, remote_id: int, proto: int, dport: int) -> LookupResult:
+        """The precedence ladder (see module docstring). Deterministic."""
+        best_key: Optional[MapStateKey] = None
+        best_entry: Optional[MapStateEntry] = None
+        deny_key: Optional[MapStateKey] = None
+        deny_entry: Optional[MapStateEntry] = None
+        for key, entry in self._entries.items():
+            if not key.covers(remote_id, proto, dport):
+                continue
+            if entry.deny:
+                if deny_key is None or _rank(key) > _rank(deny_key):
+                    deny_key, deny_entry = key, entry
+                continue
+            if best_key is None or _rank(key) > _rank(best_key):
+                best_key, best_entry = key, entry
+        if deny_entry is not None:
+            return LookupResult(C.VERDICT_DENY, deny_key, deny_entry)
+        if best_entry is None:
+            return LookupResult(C.VERDICT_MISS)
+        decision = C.VERDICT_REDIRECT if best_entry.is_redirect else C.VERDICT_ALLOW
+        return LookupResult(decision, best_key, best_entry)
+
+    def items(self) -> List[Tuple[MapStateKey, MapStateEntry]]:
+        return sorted(self._entries.items(), key=lambda kv: kv[0])
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: MapStateKey) -> bool:
+        return key in self._entries
+
+    def get(self, key: MapStateKey) -> Optional[MapStateEntry]:
+        return self._entries.get(key)
+
+
+def _rank(key: MapStateKey) -> Tuple[int, int, int, int, int]:
+    """Total order for 'most specific wins': higher tuple = more specific.
+    Ties beyond specificity: narrower port range, then higher identity,
+    higher proto, higher port_lo — arbitrary but total and documented, so the
+    oracle, compiler, and trace tool agree bit-for-bit."""
+    width = key.port_hi - key.port_lo
+    return (key.specificity(), -width, key.identity, key.proto, key.port_lo)

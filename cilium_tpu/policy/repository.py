@@ -1,0 +1,308 @@
+"""Policy Repository + per-endpoint resolution (analog of upstream
+``pkg/policy`` Repository.ResolvePolicy → EndpointPolicy, SURVEY.md §3.2).
+
+Resource model (mirrors upstream): selectors and CIDR identities are owned by
+**rules at insertion time** — ``add``/``replace_by_labels`` materialize each
+rule's peer selectors into the SelectorCache, allocate local CIDR identities,
+and upsert the ipcache (§3.2: "ipcache/identity: CIDR rules allocate local
+CIDR identities"); removing a rule releases them (identities are refcounted,
+the ipcache entry is deleted when the last reference drops). ``resolve`` is
+then a pure read: it never allocates, so it is order-independent and
+leak-free. ``toServices`` backends are re-materialized whenever the service
+registry changes (the k8s-service-watcher analog), bumping the revision so
+endpoints regenerate.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cilium_tpu.model.endpoint import Endpoint
+from cilium_tpu.model.identity import Identity, IdentityAllocator
+from cilium_tpu.model.ipcache import IPCache
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.rules import Rule, RuleBlock
+from cilium_tpu.model.services import ServiceRegistry
+from cilium_tpu.policy.mapstate import (
+    MapState, MapStateEntry, MapStateKey, PORT_WILDCARD,
+)
+from cilium_tpu.policy.selectorcache import (
+    CachedSelector, SelectorCache, cidr_selector, entity_selectors,
+)
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import normalize_prefix
+
+
+@dataclass
+class PolicyContext:
+    """Everything the repository needs besides the rules."""
+    allocator: IdentityAllocator
+    selector_cache: SelectorCache
+    ipcache: IPCache
+    services: ServiceRegistry = field(default_factory=ServiceRegistry)
+    enforcement_mode: str = C.ENFORCEMENT_DEFAULT
+    allow_localhost: bool = True
+
+
+@dataclass
+class DirectionPolicy:
+    enforced: bool
+    mapstate: MapState
+
+    def lookup(self, remote_id: int, proto: int, dport: int):
+        return self.mapstate.lookup(remote_id, proto, dport)
+
+
+@dataclass
+class EndpointPolicy:
+    ep_id: int
+    identity_id: int
+    revision: int
+    egress: DirectionPolicy
+    ingress: DirectionPolicy
+
+    def direction(self, d: int) -> DirectionPolicy:
+        return self.egress if d == C.DIR_EGRESS else self.ingress
+
+
+@dataclass
+class _BlockResources:
+    """Materialized peer side of one rule block."""
+    wildcard: bool
+    selectors: List[CachedSelector]
+
+
+@dataclass
+class _RuleResources:
+    """Everything a rule owns while resident in the repository."""
+    blocks: Dict[int, _BlockResources] = field(default_factory=dict)  # id(block)→res
+    allocations: List[Tuple[Identity, str]] = field(default_factory=list)
+    has_services: bool = False
+
+
+class Repository:
+    """Rule store with revisioning, resource ownership, and notification."""
+
+    def __init__(self, ctx: PolicyContext):
+        self._lock = threading.RLock()
+        self._ctx = ctx
+        self._rules: List[Rule] = []
+        self._resources: Dict[int, _RuleResources] = {}  # id(rule) → resources
+        self._revision = 1
+        self._observers: List[Callable[[int], None]] = []
+        ctx.services.add_observer(self._on_services_changed)
+
+    # -- rule management ----------------------------------------------------
+    @property
+    def revision(self) -> int:
+        return self._revision
+
+    def add_observer(self, obs: Callable[[int], None]) -> None:
+        """obs(new_revision) fires after any rule change (regen trigger)."""
+        self._observers.append(obs)
+
+    def _bump(self) -> int:
+        self._revision += 1
+        rev = self._revision
+        for obs in list(self._observers):
+            obs(rev)
+        return rev
+
+    def add(self, rules: Sequence[Rule]) -> int:
+        with self._lock:
+            for rule in rules:
+                self._rules.append(rule)
+                self._resources[id(rule)] = self._materialize(rule)
+            return self._bump()
+
+    def replace_by_labels(self, match: Labels, rules: Sequence[Rule]) -> int:
+        """Replace all rules carrying every label in ``match`` (the CNP
+        update path — upstream ReplaceByLabels)."""
+        with self._lock:
+            want = set(match.to_strings())
+            kept: List[Rule] = []
+            for r in self._rules:
+                if want.issubset(set(r.labels.to_strings())):
+                    self._release(self._resources.pop(id(r)))
+                else:
+                    kept.append(r)
+            self._rules = kept
+            for rule in rules:
+                self._rules.append(rule)
+                self._resources[id(rule)] = self._materialize(rule)
+            return self._bump()
+
+    def delete_by_labels(self, match: Labels) -> int:
+        return self.replace_by_labels(match, [])
+
+    def all_rules(self) -> List[Rule]:
+        with self._lock:
+            return list(self._rules)
+
+    def __len__(self) -> int:
+        return len(self._rules)
+
+    # -- resource materialization -------------------------------------------
+    def _materialize(self, rule: Rule) -> _RuleResources:
+        res = _RuleResources()
+        for block in (rule.ingress + rule.ingress_deny
+                      + rule.egress + rule.egress_deny):
+            res.blocks[id(block)] = self._materialize_block(block, res)
+        return res
+
+    def _materialize_block(self, block: RuleBlock,
+                           res: _RuleResources) -> _BlockResources:
+        ctx = self._ctx
+        peer = block.peer
+        wildcard = peer.is_empty
+        selector_objs: List[object] = []
+        for sel in peer.endpoints:
+            if sel.is_wildcard:
+                # an explicit empty fromEndpoints selector ({}) matches all
+                # endpoints; upstream compiles it to the ANY key as well
+                wildcard = True
+            else:
+                selector_objs.append(sel)
+        for ent in peer.entities:
+            for sel in entity_selectors(ent):
+                if getattr(sel, "is_wildcard", False):
+                    wildcard = True
+                else:
+                    selector_objs.append(sel)
+        for cs in peer.cidrs:
+            # Materialize CIDR identities + ipcache entries for the prefix and
+            # its excepts (excepts get identities so LPM resolves them to
+            # something the selector does NOT match).
+            for prefix in (cs.cidr, *cs.excepts):
+                ident = ctx.allocator.allocate_cidr(prefix)
+                ctx.ipcache.upsert(prefix, ident.id)
+                res.allocations.append((ident, prefix))
+            selector_objs.append(cidr_selector(cs.cidr, cs.excepts))
+        for svc_sel in peer.services:
+            res.has_services = True
+            # toServices: resolve matching services to their backend IPs and
+            # treat each backend as a host-prefix CIDR peer (upstream resolves
+            # ToServices through the service cache into selector identities).
+            for svc in ctx.services.match(svc_sel):
+                for backend_ip in svc.backends:
+                    prefix = normalize_prefix(
+                        f"{backend_ip}/128" if ":" in backend_ip
+                        else f"{backend_ip}/32")
+                    ident = ctx.allocator.allocate_cidr(prefix)
+                    ctx.ipcache.upsert(prefix, ident.id)
+                    res.allocations.append((ident, prefix))
+                    selector_objs.append(cidr_selector(prefix))
+        cached = [ctx.selector_cache.add_selector(s) for s in selector_objs]
+        return _BlockResources(wildcard=wildcard, selectors=cached)
+
+    def _release(self, res: _RuleResources) -> None:
+        ctx = self._ctx
+        for block_res in res.blocks.values():
+            for cached in block_res.selectors:
+                ctx.selector_cache.remove_selector(cached)
+        for ident, prefix in res.allocations:
+            if ctx.allocator.release(ident):
+                ctx.ipcache.delete(prefix)
+
+    def _on_services_changed(self) -> None:
+        """Service registry changed: re-materialize rules with toServices
+        (the k8s service-watcher → policy-recompute path)."""
+        with self._lock:
+            changed = False
+            for rule in self._rules:
+                res = self._resources.get(id(rule))
+                if res is None or not (res.has_services or any(
+                        b.peer.services for b in rule.egress + rule.egress_deny)):
+                    continue
+                self._release(res)
+                self._resources[id(rule)] = self._materialize(rule)
+                changed = True
+            if changed:
+                self._bump()
+
+    # -- resolution (pure read) ---------------------------------------------
+    def resolve(self, endpoint: Endpoint) -> EndpointPolicy:
+        """Compute the endpoint's EndpointPolicy at the current revision.
+        Allocation-free: all resources were materialized at rule insert."""
+        with self._lock:
+            rules = [r for r in self._rules if r.selects(endpoint.labels)]
+            revision = self._revision
+
+            mode = endpoint.enforcement or self._ctx.enforcement_mode
+            if mode == C.ENFORCEMENT_ALWAYS:
+                enforce_in = enforce_eg = True
+            elif mode == C.ENFORCEMENT_NEVER:
+                enforce_in = enforce_eg = False
+            else:
+                enforce_in = any(r.enforces_ingress for r in rules)
+                enforce_eg = any(r.enforces_egress for r in rules)
+
+            ingress = MapState()
+            egress = MapState()
+            for rule in rules:
+                res = self._resources[id(rule)]
+                tag = (rule.description or ",".join(rule.labels.to_strings())
+                       or "<unlabeled>")
+                for block in rule.ingress:
+                    self._expand(ingress, block, res, deny=False, tag=tag)
+                for block in rule.ingress_deny:
+                    self._expand(ingress, block, res, deny=True, tag=tag)
+                for block in rule.egress:
+                    self._expand(egress, block, res, deny=False, tag=tag)
+                for block in rule.egress_deny:
+                    self._expand(egress, block, res, deny=True, tag=tag)
+
+            # Host bypass: traffic from the local host to endpoints is always
+            # allowed unless host-firewall semantics are requested (upstream:
+            # LocalHostAllowed / option.Config.AlwaysAllowLocalhost).
+            if self._ctx.allow_localhost and enforce_in:
+                ingress.add(
+                    MapStateKey(C.IDENTITY_HOST, C.PROTO_ANY, *PORT_WILDCARD),
+                    MapStateEntry(deny=False, derived_from=("allow-localhost",)),
+                )
+
+            return EndpointPolicy(
+                ep_id=endpoint.ep_id,
+                identity_id=endpoint.identity_id,
+                revision=revision,
+                egress=DirectionPolicy(enforce_eg, egress),
+                ingress=DirectionPolicy(enforce_in, ingress),
+            )
+
+    def _expand(self, ms: MapState, block: RuleBlock, res: _RuleResources,
+                deny: bool, tag: str) -> None:
+        block_res = res.blocks[id(block)]
+
+        # Port side → list of (proto, lo, hi, l7_rules).
+        port_specs: List[Tuple[int, int, int, Optional[frozenset]]] = []
+        for pr in block.to_ports:
+            l7 = frozenset(pr.http) if pr.http else None
+            if not pr.ports:
+                port_specs.append((C.PROTO_ANY, *PORT_WILDCARD, l7))
+            for pp in pr.ports:
+                lo, hi = pp.port_range
+                for proto in pp.protocols():
+                    port_specs.append((proto, lo, hi, l7))
+        for icmp in block.icmps:
+            proto = C.PROTO_ICMP if icmp.family == "IPv4" else C.PROTO_ICMP6
+            port_specs.append((proto, icmp.icmp_type, icmp.icmp_type, None))
+        if not port_specs:
+            port_specs.append((C.PROTO_ANY, *PORT_WILDCARD, None))
+
+        def emit(identity: int):
+            for proto, lo, hi, l7 in port_specs:
+                if proto == C.PROTO_ANY:
+                    key = MapStateKey(identity, C.PROTO_ANY, *PORT_WILDCARD)
+                else:
+                    key = MapStateKey(identity, proto, lo, hi)
+                ms.add(key, MapStateEntry(deny=deny,
+                                          l7_rules=None if deny else l7,
+                                          derived_from=(tag,)))
+
+        if block_res.wildcard:
+            emit(C.IDENTITY_ANY)
+        for cached in block_res.selectors:
+            for ident_id in sorted(cached.identities):
+                emit(ident_id)

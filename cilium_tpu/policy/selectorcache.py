@@ -1,0 +1,143 @@
+"""SelectorCache: selector → live identity set, updated incrementally
+(analog of upstream ``pkg/policy`` SelectorCache — SURVEY.md §2: "identity↔
+selector incremental index").
+
+Selectors are any object with ``matches(labels) -> bool`` (EndpointSelector,
+the special ClusterSelector, …). The cache subscribes to the
+IdentityAllocator; each registered selector keeps a materialized set of
+matching identity ids, so MapState computation is a set read, not a scan.
+Users subscribe per-selector to drive incremental endpoint regeneration.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from cilium_tpu.model.identity import Identity, IdentityAllocator
+from cilium_tpu.model.labels import Labels, SOURCE_K8S
+from cilium_tpu.model.selectors import EndpointSelector, MatchExpression
+from cilium_tpu.utils import constants as C
+
+
+@dataclass(frozen=True)
+class ClusterSelector:
+    """Matches the 'cluster' entity: any cluster-managed workload identity
+    (has a k8s-source label) or cluster-infrastructure reserved identity.
+    Not expressible as a single label selector, hence its own type."""
+
+    _RESERVED = ("host", "remote-node", "health", "init", "ingress",
+                 "kube-apiserver", "unmanaged")
+
+    def matches(self, labels: Labels) -> bool:
+        if any(l.source == SOURCE_K8S for l in labels):
+            return True
+        return any(labels.has("reserved", name) for name in self._RESERVED)
+
+    def __str__(self) -> str:
+        return "entity:cluster"
+
+
+_ENTITY_SELECTOR_TABLE = {
+    "all": (EndpointSelector(),),
+    "world": (EndpointSelector.from_labels({"reserved:world": ""}),),
+    "host": (EndpointSelector.from_labels({"reserved:host": ""}),),
+    "remote-node": (EndpointSelector.from_labels({"reserved:remote-node": ""}),),
+    "health": (EndpointSelector.from_labels({"reserved:health": ""}),),
+    "init": (EndpointSelector.from_labels({"reserved:init": ""}),),
+    "unmanaged": (EndpointSelector.from_labels({"reserved:unmanaged": ""}),),
+    "kube-apiserver": (EndpointSelector.from_labels({"reserved:kube-apiserver": ""}),),
+    "ingress": (EndpointSelector.from_labels({"reserved:ingress": ""}),),
+    "cluster": (ClusterSelector(),),
+}
+
+
+def entity_selectors(name: str):
+    """Expand an entity name into selector objects."""
+    try:
+        return _ENTITY_SELECTOR_TABLE[name]
+    except KeyError:
+        raise ValueError(f"unknown entity {name!r}")
+
+
+def cidr_selector(cidr: str, excepts: Tuple[str, ...] = ()) -> EndpointSelector:
+    """Selector matching all CIDR identities at-or-below ``cidr`` while
+    excluding those at-or-below any except prefix (works because CIDR
+    identities carry labels for every parent prefix)."""
+    return EndpointSelector(
+        match_labels=((f"cidr:{cidr}", ""),),
+        match_expressions=tuple(
+            MatchExpression(key=f"cidr:{e}", operator="DoesNotExist")
+            for e in excepts),
+    )
+
+
+class CachedSelector:
+    """A registered selector + its materialized identity set."""
+
+    def __init__(self, selector, cache: "SelectorCache"):
+        self.selector = selector
+        self._cache = cache
+        self._ids: Set[int] = set()
+        self._subscribers: List[Callable[[Set[int], Set[int]], None]] = []
+        self.refcount = 0
+
+    @property
+    def identities(self) -> frozenset:
+        return frozenset(self._ids)
+
+    def subscribe(self, fn: Callable[[Set[int], Set[int]], None]) -> None:
+        """fn(added_ids, removed_ids) fires on incremental identity changes."""
+        self._subscribers.append(fn)
+
+    def _apply(self, added: Set[int], removed: Set[int]) -> None:
+        self._ids |= added
+        self._ids -= removed
+        for fn in list(self._subscribers):
+            fn(added, removed)
+
+
+class SelectorCache:
+    def __init__(self, allocator: IdentityAllocator):
+        self._lock = threading.RLock()
+        self._allocator = allocator
+        self._selectors: Dict[str, CachedSelector] = {}
+        # Observe identities; replay=True seeds current identities.
+        allocator.add_observer(self._on_identities, replay=False)
+
+    def _key(self, selector) -> str:
+        return f"{type(selector).__name__}:{selector}"
+
+    def add_selector(self, selector) -> CachedSelector:
+        """Register (or ref) a selector; materializes its identity set."""
+        with self._lock:
+            key = self._key(selector)
+            cached = self._selectors.get(key)
+            if cached is None:
+                cached = CachedSelector(selector, self)
+                matched = {
+                    ident.id for ident in self._allocator.all()
+                    if selector.matches(ident.labels)
+                }
+                cached._apply(matched, set())
+                self._selectors[key] = cached
+            cached.refcount += 1
+            return cached
+
+    def remove_selector(self, cached: CachedSelector) -> None:
+        with self._lock:
+            cached.refcount -= 1
+            if cached.refcount <= 0:
+                self._selectors.pop(self._key(cached.selector), None)
+
+    def _on_identities(self, added: List[Identity], removed: List[Identity]) -> None:
+        with self._lock:
+            for cached in self._selectors.values():
+                add_ids = {i.id for i in added if cached.selector.matches(i.labels)}
+                rem_ids = {i.id for i in removed if i.id in cached._ids}
+                if add_ids or rem_ids:
+                    cached._apply(add_ids, rem_ids)
+
+    def __len__(self) -> int:
+        return len(self._selectors)

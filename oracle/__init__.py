@@ -1,0 +1,11 @@
+"""The eBPF-semantics oracle: slow, exact, pure-Python reference model of the
+datapath verdict function. Per SURVEY.md §0 verification protocol step 2, the
+reference mount was empty, so THIS MODEL IS THE PARITY CONTRACT — the TPU
+kernels must agree with it bit-for-bit, and every report must say so.
+"""
+
+from oracle.datapath import (
+    ConntrackTable, CTEntry, Oracle, PacketRecord, Verdict,
+)
+
+__all__ = ["ConntrackTable", "CTEntry", "Oracle", "PacketRecord", "Verdict"]

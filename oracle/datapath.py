@@ -1,0 +1,351 @@
+"""Exact sequential/batch semantic model of the per-packet datapath.
+
+Pipeline per packet (mirrors SURVEY.md §3.3, the bpf_lxc.c handle_xgress
+shape: parse → ipcache LPM → conntrack → policy ladder → CT create/update):
+
+1. remote address = dst (egress) / src (ingress); ipcache LPM → remote
+   security identity (miss → reserved:world).
+2. Conntrack lookup on the normalized tuple:
+   - forward key hit   → ESTABLISHED (skip the policy ladder)
+   - reverse key hit   → REPLY       (skip the policy ladder)
+   - neither           → NEW         (run the ladder)
+   Expired entries count as misses. Entries created by an allowed NEW packet.
+3. Policy: the MapState precedence ladder (deny-wins → most-specific allow →
+   default deny iff direction enforced).
+4. L7-lite: entries with http rules mark the CT entry `redirect`; packets
+   carrying request tokens (method != NONE) on a redirect flow are matched
+   against the http rules each time (the per-request proxy-decision analog);
+   token-less packets (e.g. the TCP handshake) pass at L4.
+
+Batch semantics — THE CONTRACT FOR THE TPU KERNELS:
+- `sequential` mode: packets are processed one at a time, CT effects visible
+  to the next packet. This is what the real eBPF datapath does.
+- `snapshot` mode: all packets see the CT state from batch start; CT effects
+  are applied afterwards as an order-independent aggregate (flags OR, counter
+  sums, expiry recomputed from aggregated flags). This is what a data-parallel
+  TPU batch computes. For batch size 1 the two modes coincide (test-enforced);
+  verdict divergence is possible only for intra-batch flow interleavings and
+  is measured, not hidden (see tests/test_parity.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from cilium_tpu.model.ipcache import lpm_lookup
+from cilium_tpu.policy.repository import EndpointPolicy
+from cilium_tpu.utils import constants as C
+
+CT_NO_L7 = 0  # l7_id value meaning "no redirect"
+
+
+# --------------------------------------------------------------------------- #
+# Packet record — the 64B fixed record the AF_XDP shim emits (shim/ doc).
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class PacketRecord:
+    src_addr: bytes                 # 16B normalized (v4-mapped)
+    dst_addr: bytes                 # 16B normalized
+    src_port: int                   # 0 for port-less protos
+    dst_port: int                   # ICMP: the ICMP type
+    proto: int                      # IP protocol number
+    tcp_flags: int = 0              # low byte of TCP flags; 0 otherwise
+    is_ipv6: bool = False
+    ep_id: int = 0                  # local endpoint the packet belongs to
+    direction: int = C.DIR_EGRESS
+    # L7-lite tokens (from the shim's HTTP tokenizer); method NONE → no tokens
+    http_method: int = C.HTTP_METHOD_ANY  # method id, 255 = no tokens
+    http_path: bytes = b""
+
+    @property
+    def has_l7_tokens(self) -> bool:
+        return self.http_method != C.HTTP_METHOD_ANY or bool(self.http_path)
+
+
+@dataclass(frozen=True)
+class Verdict:
+    allow: bool
+    drop_reason: int                # C.DropReason
+    ct_status: int                  # C.CTStatus
+    remote_identity: int
+    redirect: bool = False          # went through L7-lite matching
+    matched_key: Optional[object] = None  # MapStateKey for trace
+
+
+# --------------------------------------------------------------------------- #
+# Conntrack
+# --------------------------------------------------------------------------- #
+CTKey = Tuple[bytes, bytes, int, int, int, int]  # (src,dst,sport,dport,proto,open_dir)
+
+
+@dataclass
+class CTEntry:
+    expiry: int
+    created: int
+    flags: int = 0                  # CT_FLAG_*
+    redirect_l7_id: int = CT_NO_L7  # non-zero → L7-lite flow, id into l7 sets
+    pkts_fwd: int = 0
+    pkts_rev: int = 0
+
+
+def _tcp_lifetime(flags: int) -> int:
+    if flags & (C.CT_FLAG_TX_CLOSING | C.CT_FLAG_RX_CLOSING):
+        return C.CT_LIFETIME_CLOSE
+    if flags & C.CT_FLAG_SEEN_NON_SYN:
+        return C.CT_LIFETIME_TCP
+    return C.CT_LIFETIME_SYN
+
+
+def _flag_delta(proto: int, tcp_flags: int, is_reply: bool) -> int:
+    """CT flag bits contributed by one observed packet."""
+    if proto != C.PROTO_TCP:
+        return 0
+    delta = 0
+    if tcp_flags & (C.TCP_FIN | C.TCP_RST):
+        delta |= C.CT_FLAG_RX_CLOSING if is_reply else C.CT_FLAG_TX_CLOSING
+        if tcp_flags & C.TCP_RST:
+            delta |= C.CT_FLAG_RX_CLOSING | C.CT_FLAG_TX_CLOSING
+    if not (tcp_flags & C.TCP_SYN):
+        delta |= C.CT_FLAG_SEEN_NON_SYN
+    return delta
+
+
+def _entry_expiry(proto: int, flags: int, now: int) -> int:
+    if proto == C.PROTO_TCP:
+        return now + _tcp_lifetime(flags)
+    return now + C.CT_LIFETIME_NONTCP
+
+
+class ConntrackTable:
+    """Host-exact CT table. The device table must agree on lookup results,
+    flags, and expiry for every key (counters too, in snapshot mode)."""
+
+    def __init__(self):
+        self.entries: Dict[CTKey, CTEntry] = {}
+
+    @staticmethod
+    def fwd_key(p: PacketRecord) -> CTKey:
+        return (p.src_addr, p.dst_addr, p.src_port, p.dst_port, p.proto,
+                p.direction)
+
+    @staticmethod
+    def rev_key(p: PacketRecord) -> CTKey:
+        return (p.dst_addr, p.src_addr, p.dst_port, p.src_port, p.proto,
+                1 - p.direction)
+
+    def probe(self, p: PacketRecord, now: int) -> Tuple[int, Optional[CTKey]]:
+        """(CTStatus, hit key) against current state; expired = miss."""
+        k = self.fwd_key(p)
+        e = self.entries.get(k)
+        if e is not None and e.expiry > now:
+            return C.CTStatus.ESTABLISHED, k
+        k = self.rev_key(p)
+        e = self.entries.get(k)
+        if e is not None and e.expiry > now:
+            return C.CTStatus.REPLY, k
+        return C.CTStatus.NEW, None
+
+    def update(self, key: CTKey, p: PacketRecord, is_reply: bool, now: int) -> None:
+        e = self.entries[key]
+        e.flags |= _flag_delta(p.proto, p.tcp_flags, is_reply)
+        e.expiry = _entry_expiry(p.proto, e.flags, now)
+        if is_reply:
+            e.pkts_rev += 1
+        else:
+            e.pkts_fwd += 1
+
+    def create(self, p: PacketRecord, now: int, l7_id: int = CT_NO_L7) -> CTKey:
+        key = self.fwd_key(p)
+        flags = _flag_delta(p.proto, p.tcp_flags, is_reply=False)
+        self.entries[key] = CTEntry(
+            expiry=_entry_expiry(p.proto, flags, now),
+            created=now,
+            flags=flags,
+            redirect_l7_id=l7_id,
+            pkts_fwd=1,
+        )
+        return key
+
+    def sweep(self, now: int) -> int:
+        """GC expired entries (upstream: ctmap GC); returns count removed."""
+        dead = [k for k, e in self.entries.items() if e.expiry <= now]
+        for k in dead:
+            del self.entries[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+# --------------------------------------------------------------------------- #
+# L7-lite matching
+# --------------------------------------------------------------------------- #
+def l7_match(http_rules, method: int, path: bytes) -> bool:
+    """True iff any rule admits (method, path): method exact-or-any AND the
+    rule's path is a byte-prefix of the request path."""
+    for rule in http_rules:
+        m_ok = (not rule.method) or (C.HTTP_METHOD_IDS.get(rule.method) == method)
+        p = rule.path.encode()
+        if m_ok and path[: len(p)] == p:
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------- #
+# The oracle
+# --------------------------------------------------------------------------- #
+class Oracle:
+    def __init__(self, policies: Dict[int, EndpointPolicy],
+                 ipcache_entries: Dict[str, int],
+                 ct: Optional[ConntrackTable] = None):
+        self.policies = policies
+        self.ipcache_entries = dict(ipcache_entries)
+        self.ct = ct if ct is not None else ConntrackTable()
+        # l7 sets are interned per-policy at lookup time: id = index+1 into
+        # this list (0 = no redirect), shared across endpoints.
+        self.l7_sets: List[frozenset] = []
+        self._l7_index: Dict[frozenset, int] = {}
+
+    # -- helpers ------------------------------------------------------------
+    def _l7_id(self, rules: frozenset) -> int:
+        idx = self._l7_index.get(rules)
+        if idx is None:
+            self.l7_sets.append(rules)
+            idx = len(self.l7_sets)  # 1-based; 0 = none
+            self._l7_index[rules] = idx
+        return idx
+
+    def _remote_identity(self, p: PacketRecord) -> int:
+        from cilium_tpu.utils.ip import addr_to_str
+        remote = p.dst_addr if p.direction == C.DIR_EGRESS else p.src_addr
+        return lpm_lookup(self.ipcache_entries, addr_to_str(remote))
+
+    def _policy_verdict(self, p: PacketRecord, remote_id: int):
+        """(allow, drop_reason, redirect, l7_id, matched_key)."""
+        pol = self.policies.get(p.ep_id)
+        if pol is None:
+            return False, C.DropReason.INVALID_IDENTITY, False, CT_NO_L7, None
+        dirpol = pol.direction(p.direction)
+        if not dirpol.enforced:
+            return True, C.DropReason.OK, False, CT_NO_L7, None
+        res = dirpol.lookup(remote_id, p.proto, p.dst_port)
+        if res.decision == C.VERDICT_DENY:
+            return False, C.DropReason.POLICY_DENY, False, CT_NO_L7, res.key
+        if res.decision == C.VERDICT_MISS:
+            return False, C.DropReason.POLICY, False, CT_NO_L7, res.key
+        if res.decision == C.VERDICT_REDIRECT:
+            l7_id = self._l7_id(res.entry.l7_rules)
+            if p.has_l7_tokens:
+                ok = l7_match(res.entry.l7_rules, p.http_method, p.http_path)
+                reason = C.DropReason.OK if ok else C.DropReason.POLICY_L7
+                return ok, reason, True, l7_id, res.key
+            return True, C.DropReason.OK, True, l7_id, res.key
+        return True, C.DropReason.OK, False, CT_NO_L7, res.key
+
+    # -- sequential (true eBPF per-packet semantics) ------------------------
+    def classify(self, p: PacketRecord, now: int) -> Verdict:
+        remote_id = self._remote_identity(p)
+        status, hit_key = self.ct.probe(p, now)
+
+        if status != C.CTStatus.NEW:
+            entry = self.ct.entries[hit_key]
+            # Established L7-lite flows re-check tokens per request.
+            if entry.redirect_l7_id != CT_NO_L7 and p.has_l7_tokens:
+                rules = self.l7_sets[entry.redirect_l7_id - 1]
+                if not l7_match(rules, p.http_method, p.http_path):
+                    return Verdict(False, C.DropReason.POLICY_L7, status,
+                                   remote_id, redirect=True)
+            self.ct.update(hit_key, p, is_reply=(status == C.CTStatus.REPLY),
+                           now=now)
+            return Verdict(True, C.DropReason.OK, status, remote_id,
+                           redirect=entry.redirect_l7_id != CT_NO_L7)
+
+        allow, reason, redirect, l7_id, key = self._policy_verdict(p, remote_id)
+        if allow:
+            self.ct.create(p, now, l7_id=l7_id)
+        return Verdict(allow, reason, C.CTStatus.NEW, remote_id,
+                       redirect=redirect, matched_key=key)
+
+    def classify_batch_sequential(self, packets: List[PacketRecord],
+                                  now: int) -> List[Verdict]:
+        return [self.classify(p, now) for p in packets]
+
+    # -- snapshot (data-parallel TPU batch semantics) -----------------------
+    def classify_batch_snapshot(self, packets: List[PacketRecord],
+                                now: int) -> List[Verdict]:
+        # Phase 1: all verdicts against the CT snapshot at batch start.
+        # l7_ids[i] carries the policy-computed l7 id for NEW packets so
+        # phase 2 never re-runs the ladder.
+        verdicts: List[Verdict] = []
+        probes: List[Tuple[int, Optional[CTKey]]] = []
+        l7_ids: List[int] = []
+        for p in packets:
+            remote_id = self._remote_identity(p)
+            status, hit_key = self.ct.probe(p, now)
+            probes.append((status, hit_key))
+            if status != C.CTStatus.NEW:
+                l7_ids.append(CT_NO_L7)
+                entry = self.ct.entries[hit_key]
+                if entry.redirect_l7_id != CT_NO_L7 and p.has_l7_tokens:
+                    rules = self.l7_sets[entry.redirect_l7_id - 1]
+                    if not l7_match(rules, p.http_method, p.http_path):
+                        verdicts.append(Verdict(False, C.DropReason.POLICY_L7,
+                                                status, remote_id, redirect=True))
+                        continue
+                verdicts.append(Verdict(True, C.DropReason.OK, status, remote_id,
+                                        redirect=entry.redirect_l7_id != CT_NO_L7))
+            else:
+                allow, reason, redirect, l7_id, key = self._policy_verdict(
+                    p, remote_id)
+                l7_ids.append(l7_id)
+                verdicts.append(Verdict(allow, reason, C.CTStatus.NEW, remote_id,
+                                        redirect=redirect, matched_key=key))
+
+        # Phase 2: order-independent aggregate CT effects.
+        #   For each touched key: flags |= OR of deltas; counters += sums;
+        #   expiry recomputed once from aggregated flags.
+        agg: Dict[CTKey, Dict] = {}
+
+        def touch(key: CTKey):
+            return agg.setdefault(key, {
+                "flag_delta": 0, "fwd": 0, "rev": 0,
+                "create": None, "l7_id": CT_NO_L7,
+            })
+
+        for p, v, (status, hit_key), l7_id in zip(packets, verdicts, probes,
+                                                  l7_ids):
+            if status == C.CTStatus.ESTABLISHED and v.allow:
+                a = touch(hit_key)
+                a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, False)
+                a["fwd"] += 1
+            elif status == C.CTStatus.REPLY and v.allow:
+                a = touch(hit_key)
+                a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, True)
+                a["rev"] += 1
+            elif status == C.CTStatus.NEW and v.allow:
+                key = ConntrackTable.fwd_key(p)
+                a = touch(key)
+                a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, False)
+                a["fwd"] += 1
+                if a["create"] is None:
+                    # l7 id of the *winning* (first) creator
+                    a["create"] = p
+                    a["l7_id"] = l7_id
+
+        for key, a in agg.items():
+            entry = self.ct.entries.get(key)
+            if entry is not None and entry.expiry <= now and a["create"] is not None:
+                entry = None  # expired slot is replaced, not updated
+            if entry is None:
+                if a["create"] is None:
+                    continue
+                entry = CTEntry(expiry=0, created=now,
+                                redirect_l7_id=a["l7_id"])
+                self.ct.entries[key] = entry
+            proto = key[4]
+            entry.flags |= a["flag_delta"]
+            entry.pkts_fwd += a["fwd"]
+            entry.pkts_rev += a["rev"]
+            entry.expiry = _entry_expiry(proto, entry.flags, now)
+        return verdicts

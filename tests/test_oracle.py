@@ -1,0 +1,248 @@
+"""Oracle semantics tests: CT state machine, policy interaction, L7-lite,
+sequential vs snapshot batch modes."""
+
+import pytest
+
+from cilium_tpu.model.endpoint import Endpoint
+from cilium_tpu.model.identity import IdentityAllocator
+from cilium_tpu.model.ipcache import IPCache
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.rules import parse_rule
+from cilium_tpu.policy.repository import PolicyContext, Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import ConntrackTable, Oracle, PacketRecord
+
+
+def build_world(rules, ep_labels=("k8s:app=web",), extra_ipcache=None):
+    alloc = IdentityAllocator()
+    ipcache = IPCache()
+    ctx = PolicyContext(allocator=alloc, selector_cache=SelectorCache(alloc),
+                        ipcache=ipcache)
+    repo = Repository(ctx)
+    lbls = Labels.parse(ep_labels)
+    ident = alloc.allocate(lbls)
+    ep = Endpoint(ep_id=1, labels=lbls, identity_id=ident.id,
+                  ips=("192.168.1.10",))
+    ipcache.upsert("192.168.1.10/32", ident.id)
+    repo.add([parse_rule(r) for r in rules])
+    pol = repo.resolve(ep)
+    entries = ipcache.snapshot()
+    if extra_ipcache:
+        entries.update(extra_ipcache)
+    return Oracle({1: pol}, entries), ctx
+
+
+def pkt(dst="10.1.2.3", sport=40000, dport=443, proto=C.PROTO_TCP,
+        flags=C.TCP_SYN, src="192.168.1.10", direction=C.DIR_EGRESS,
+        ep_id=1, method=C.HTTP_METHOD_ANY, path=b""):
+    s, s6 = parse_addr(src)
+    d, d6 = parse_addr(dst)
+    return PacketRecord(src_addr=s, dst_addr=d, src_port=sport, dst_port=dport,
+                        proto=proto, tcp_flags=flags, is_ipv6=s6 or d6,
+                        ep_id=ep_id, direction=direction,
+                        http_method=method, http_path=path)
+
+
+EGRESS_CIDR_RULE = {
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toCIDR": ["10.0.0.0/8"],
+                "toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]}],
+}
+
+
+class TestPipeline:
+    def test_allowed_flow_creates_ct(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        v = o.classify(pkt(), now=100)
+        assert v.allow and v.ct_status == C.CTStatus.NEW
+        assert v.remote_identity & C.LOCAL_IDENTITY_SCOPE
+        assert len(o.ct) == 1
+
+    def test_denied_flow_no_ct(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        v = o.classify(pkt(dport=80), now=100)
+        assert not v.allow and v.drop_reason == C.DropReason.POLICY
+        assert len(o.ct) == 0
+
+    def test_established_skips_policy(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        assert o.classify(pkt(), now=100).allow
+        # second packet same tuple → ESTABLISHED even though policy would deny
+        # nothing here; change policy by attacking another port: the CT hit is
+        # on the exact tuple, so just verify status.
+        v = o.classify(pkt(flags=C.TCP_ACK), now=101)
+        assert v.allow and v.ct_status == C.CTStatus.ESTABLISHED
+
+    def test_reply_direction(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        assert o.classify(pkt(), now=100).allow
+        # reply: src/dst swapped, ingress direction — no ingress policy exists
+        # (unenforced), but the point is it's recognized as REPLY
+        reply = pkt(src="10.1.2.3", dst="192.168.1.10", sport=443, dport=40000,
+                    flags=C.TCP_ACK, direction=C.DIR_INGRESS)
+        v = o.classify(reply, now=101)
+        assert v.allow and v.ct_status == C.CTStatus.REPLY
+
+    def test_reply_of_denied_ingress_flow_passes_via_ct(self):
+        """An egress-opened flow's replies pass even under a default-deny
+        ingress policy — the CT REPLY path skips the ladder."""
+        rules = [EGRESS_CIDR_RULE,
+                 {"endpointSelector": {"matchLabels": {"app": "web"}},
+                  "ingress": []}]  # enforce ingress, allow nothing
+        o, _ = build_world(rules)
+        assert o.classify(pkt(), now=100).allow
+        reply = pkt(src="10.1.2.3", dst="192.168.1.10", sport=443, dport=40000,
+                    flags=C.TCP_ACK, direction=C.DIR_INGRESS)
+        v = o.classify(reply, now=101)
+        assert v.allow and v.ct_status == C.CTStatus.REPLY
+        # but a NEW ingress flow is dropped
+        fresh = pkt(src="10.9.9.9", dst="192.168.1.10", sport=555, dport=8080,
+                    direction=C.DIR_INGRESS)
+        v2 = o.classify(fresh, now=101)
+        assert not v2.allow and v2.drop_reason == C.DropReason.POLICY
+
+    def test_world_miss(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        v = o.classify(pkt(dst="8.8.8.8"), now=100)
+        assert v.remote_identity == C.IDENTITY_WORLD and not v.allow
+
+
+class TestCTStateMachine:
+    def test_syn_timeout_vs_established(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(flags=C.TCP_SYN), now=100)
+        e = next(iter(o.ct.entries.values()))
+        assert e.expiry == 100 + C.CT_LIFETIME_SYN
+        o.classify(pkt(flags=C.TCP_ACK), now=110)
+        assert e.expiry == 110 + C.CT_LIFETIME_TCP
+        assert e.flags & C.CT_FLAG_SEEN_NON_SYN
+
+    def test_fin_moves_to_close_timeout(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(flags=C.TCP_SYN), now=100)
+        o.classify(pkt(flags=C.TCP_ACK), now=101)
+        o.classify(pkt(flags=C.TCP_FIN | C.TCP_ACK), now=102)
+        e = next(iter(o.ct.entries.values()))
+        assert e.flags & C.CT_FLAG_TX_CLOSING
+        assert e.expiry == 102 + C.CT_LIFETIME_CLOSE
+
+    def test_rst_closes_both(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(flags=C.TCP_SYN), now=100)
+        o.classify(pkt(flags=C.TCP_RST), now=101)
+        e = next(iter(o.ct.entries.values()))
+        assert e.flags & C.CT_FLAG_TX_CLOSING and e.flags & C.CT_FLAG_RX_CLOSING
+
+    def test_expired_entry_is_new_again(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(flags=C.TCP_SYN), now=100)
+        v = o.classify(pkt(flags=C.TCP_SYN), now=100 + C.CT_LIFETIME_SYN + 1)
+        assert v.ct_status == C.CTStatus.NEW
+
+    def test_udp_lifetime(self):
+        o, _ = build_world([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toCIDR": ["10.0.0.0/8"]}]}])
+        o.classify(pkt(proto=C.PROTO_UDP, dport=53, flags=0), now=100)
+        e = next(iter(o.ct.entries.values()))
+        assert e.expiry == 100 + C.CT_LIFETIME_NONTCP
+
+    def test_sweep(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(), now=100)
+        assert o.ct.sweep(now=100 + C.CT_LIFETIME_SYN + 1) == 1
+        assert len(o.ct) == 0
+
+
+class TestL7Lite:
+    RULES = [{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "ingress": [{"toPorts": [{
+            "ports": [{"port": "80", "protocol": "TCP"}],
+            "rules": {"http": [{"method": "GET", "path": "/api"}]},
+        }]}],
+    }]
+
+    def in_pkt(self, **kw):
+        kw.setdefault("src", "10.9.9.9")
+        kw.setdefault("dst", "192.168.1.10")
+        kw.setdefault("sport", 5555)
+        kw.setdefault("dport", 80)
+        kw.setdefault("direction", C.DIR_INGRESS)
+        return pkt(**kw)
+
+    def test_handshake_passes_without_tokens(self):
+        o, _ = build_world(self.RULES)
+        v = o.classify(self.in_pkt(flags=C.TCP_SYN), now=100)
+        assert v.allow and v.redirect
+
+    def test_request_token_match(self):
+        o, _ = build_world(self.RULES)
+        o.classify(self.in_pkt(flags=C.TCP_SYN), now=100)
+        good = self.in_pkt(flags=C.TCP_ACK, method=C.HTTP_METHOD_IDS["GET"],
+                           path=b"/api/users")
+        assert o.classify(good, now=101).allow
+        bad_path = self.in_pkt(flags=C.TCP_ACK, method=C.HTTP_METHOD_IDS["GET"],
+                               path=b"/admin")
+        v = o.classify(bad_path, now=102)
+        assert not v.allow and v.drop_reason == C.DropReason.POLICY_L7
+        bad_method = self.in_pkt(flags=C.TCP_ACK,
+                                 method=C.HTTP_METHOD_IDS["POST"], path=b"/api")
+        assert not o.classify(bad_method, now=103).allow
+
+    def test_l7_on_new_flow_with_tokens(self):
+        o, _ = build_world(self.RULES)
+        v = o.classify(self.in_pkt(flags=C.TCP_ACK,
+                                   method=C.HTTP_METHOD_IDS["GET"],
+                                   path=b"/api"), now=100)
+        assert v.allow and v.redirect
+
+
+class TestBatchModes:
+    def test_batch_size_one_equivalence(self):
+        """snapshot mode with batch size 1 must equal sequential mode."""
+        import copy
+        o1, _ = build_world([EGRESS_CIDR_RULE])
+        o2, _ = build_world([EGRESS_CIDR_RULE])
+        packets = [
+            pkt(flags=C.TCP_SYN),
+            pkt(flags=C.TCP_ACK),
+            pkt(dst="10.5.5.5", dport=443, flags=C.TCP_SYN),
+            pkt(dport=80),  # denied
+            pkt(flags=C.TCP_FIN),
+        ]
+        seq = o1.classify_batch_sequential(packets, now=100)
+        snap = []
+        for p in packets:
+            snap.extend(o2.classify_batch_snapshot([p], now=100))
+        assert [(v.allow, v.drop_reason, v.ct_status) for v in seq] == \
+               [(v.allow, v.drop_reason, v.ct_status) for v in snap]
+        assert o1.ct.entries.keys() == o2.ct.entries.keys()
+        for k in o1.ct.entries:
+            e1, e2 = o1.ct.entries[k], o2.ct.entries[k]
+            assert (e1.flags, e1.expiry, e1.pkts_fwd, e1.pkts_rev) == \
+                   (e2.flags, e2.expiry, e2.pkts_fwd, e2.pkts_rev)
+
+    def test_snapshot_intra_batch_new_flow(self):
+        """Two packets of the same new flow in one batch: both NEW under
+        snapshot semantics, one CT entry, counters aggregated."""
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        batch = [pkt(flags=C.TCP_SYN), pkt(flags=C.TCP_ACK)]
+        vs = o.classify_batch_snapshot(batch, now=100)
+        assert [v.ct_status for v in vs] == [C.CTStatus.NEW, C.CTStatus.NEW]
+        assert len(o.ct) == 1
+        e = next(iter(o.ct.entries.values()))
+        assert e.pkts_fwd == 2
+        assert e.flags & C.CT_FLAG_SEEN_NON_SYN
+        assert e.expiry == 100 + C.CT_LIFETIME_TCP
+
+    def test_snapshot_established_flow_updates(self):
+        o, _ = build_world([EGRESS_CIDR_RULE])
+        o.classify(pkt(flags=C.TCP_SYN), now=100)
+        vs = o.classify_batch_snapshot(
+            [pkt(flags=C.TCP_ACK), pkt(flags=C.TCP_ACK)], now=105)
+        assert all(v.ct_status == C.CTStatus.ESTABLISHED for v in vs)
+        e = next(iter(o.ct.entries.values()))
+        assert e.pkts_fwd == 3 and e.expiry == 105 + C.CT_LIFETIME_TCP
