@@ -1,0 +1,27 @@
+# Development entry points.
+#
+# Tests run on the CPU backend with 8 fake devices (SURVEY.md §4) and with
+# the axon TPU plugin *disabled*: the sitecustomize in this image claims a
+# TPU session for every Python interpreter when PALLAS_AXON_POOL_IPS is set,
+# which is slow/serialized — and tests must not touch the real chip anyway.
+
+PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+             XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: test test-fast shim bench clean
+
+test:
+	$(PYTEST_ENV) python -m pytest tests/ -q
+
+test-fast:
+	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
+
+shim:
+	$(MAKE) -C cilium_tpu/shim
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C cilium_tpu/shim clean 2>/dev/null || true
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -1,0 +1,19 @@
+"""The tensor compiler — "the loader" (analog of upstream
+``pkg/datapath/loader``; SURVEY.md §2: "Replace with rule→tensor compiler +
+jit cache; this is the plugin boundary kept intact").
+
+Lowers host control-plane state into dense device tensor images:
+
+- ``lpm.py``         — ipcache snapshot → stride-8 multibit-trie tensors
+- ``portclass.py``   — L4 port ranges → per-proto-family equivalence classes
+- ``idclass.py``     — identities → equivalence classes over MapState rows
+- ``policy_image.py``— MapState → dense ``verdict[id_class, port_class]``
+                       (the whole precedence ladder resolved at compile time)
+- ``l7.py``          — L7-lite http rule sets → token-match tensors
+- ``ct_layout.py``   — fixed-capacity conntrack table array layout
+- ``snapshot.py``    — PolicySnapshot: one immutable, device-placeable bundle
+"""
+
+from cilium_tpu.compile.snapshot import PolicySnapshot, build_snapshot
+
+__all__ = ["PolicySnapshot", "build_snapshot"]

@@ -1,0 +1,48 @@
+"""Fixed-capacity conntrack table layout (analog of upstream
+``pkg/maps/ctmap`` — SURVEY.md §2: "Becomes fixed-capacity device hash table").
+
+Structure-of-arrays layout, power-of-two capacity, open addressing with
+bounded linear probing (PROBE_DEPTH slots). No dynamic memory on device —
+insert failures (all probe slots live) are counted and the packet still gets
+its policy verdict (fail-open on tracking, fail-closed on policy), and a
+device-side epoch sweep (kernels/conntrack.py) reclaims expired slots.
+
+Key: 10 uint32 words — src[4] + dst[4] (16-byte normalized addresses) +
+(sport<<16|dport) + (proto<<8|open_dir). An all-zero key with expiry 0 marks
+an empty slot; real keys always have a nonzero proto word.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+import numpy as np
+
+KEY_WORDS = 10
+PROBE_DEPTH = 8
+
+
+@dataclass
+class CTConfig:
+    capacity: int = 1 << 20          # 1M flows (BASELINE config 5)
+    probe_depth: int = PROBE_DEPTH
+
+    def __post_init__(self):
+        if self.capacity & (self.capacity - 1):
+            raise ValueError("CT capacity must be a power of two")
+
+
+def make_ct_arrays(cfg: CTConfig) -> Dict[str, np.ndarray]:
+    """Fresh empty table. Kept as a dict-of-arrays pytree so jit donation and
+    shard_map partitioning apply uniformly."""
+    cap = cfg.capacity
+    return {
+        "keys": np.zeros((cap, KEY_WORDS), dtype=np.uint32),
+        "expiry": np.zeros((cap,), dtype=np.uint32),
+        "created": np.zeros((cap,), dtype=np.uint32),
+        "flags": np.zeros((cap,), dtype=np.uint32),
+        "l7_id": np.zeros((cap,), dtype=np.uint32),
+        "pkts_fwd": np.zeros((cap,), dtype=np.uint32),
+        "pkts_rev": np.zeros((cap,), dtype=np.uint32),
+    }

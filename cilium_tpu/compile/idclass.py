@@ -1,0 +1,79 @@
+"""Identity equivalence classes (the compile-time half of the identity axis).
+
+Two identities whose concrete-keyed MapState entries are identical across
+every (endpoint, direction) in the snapshot always receive identical verdict
+rows, so the dense tensor needs one row per *class*, not per identity. This
+is the rule-space compression that keeps a 10k-identity × 50k-rule policy in
+HBM (SURVEY.md §2 parallelism table: "policymap tensors sharded by
+identity-row" — classes shrink the row space before sharding even starts).
+
+The signature is computed directly from the MapStates being compiled (not
+from selectors), so it is correct by construction: same signature ⇒ same
+entries ⇒ same row. Identities mentioned by no concrete entry share class 0
+(only wildcard-ANY entries apply to them).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.policy.mapstate import MapState
+
+
+@dataclass(frozen=True)
+class IdentityClasses:
+    identity_ids: np.ndarray    # [n_identities] int64, sorted — index → id
+    index_of: Dict[int, int]    # id → identity index
+    class_of: np.ndarray        # [n_identities] int32 — identity index → class
+    n_classes: int
+    # one representative identity id per class (class 0 may have none → -1)
+    representative: np.ndarray  # [n_classes] int64
+
+
+def build_identity_classes(
+    identity_ids: Sequence[int],
+    mapstates: Iterable[Tuple[int, int, MapState]],
+) -> IdentityClasses:
+    """``mapstates`` yields (ep_slot, direction, MapState)."""
+    ids = np.asarray(sorted(identity_ids), dtype=np.int64)
+    index_of = {int(v): i for i, v in enumerate(ids)}
+
+    # signature: frozenset of (ep, dir, key-sans-identity, value-digest)
+    sigs: Dict[int, List] = {int(v): [] for v in ids}
+    for ep_slot, direction, ms in mapstates:
+        for key, entry in ms.items():
+            if key.identity == 0:      # ANY entries apply to every row
+                continue
+            ident = int(key.identity)
+            if ident not in sigs:
+                # entry for an identity outside the snapshot's identity set
+                # (e.g. already released) — no row to write, skip
+                continue
+            digest = (ep_slot, direction, key.proto, key.port_lo, key.port_hi,
+                      entry.deny,
+                      tuple(sorted((h.method, h.path)
+                                   for h in entry.l7_rules))
+                      if entry.l7_rules is not None else None)
+            sigs[ident].append(digest)
+
+    class_index: Dict[frozenset, int] = {frozenset(): 0}
+    reps: List[int] = [-1]
+    class_of = np.zeros(len(ids), dtype=np.int32)
+    for i, ident in enumerate(ids):
+        sig = frozenset(sigs[int(ident)])
+        cls = class_index.get(sig)
+        if cls is None:
+            cls = len(class_index)
+            class_index[sig] = cls
+            reps.append(int(ident))
+        class_of[i] = cls
+    return IdentityClasses(
+        identity_ids=ids,
+        index_of=index_of,
+        class_of=class_of,
+        n_classes=len(class_index),
+        representative=np.asarray(reps, dtype=np.int64),
+    )

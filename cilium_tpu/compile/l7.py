@@ -1,0 +1,97 @@
+"""L7-lite rule-set tensors (BASELINE config 4, the envoy-bypass path).
+
+Each distinct frozenset of HTTPRules is interned to a 1-based set id (0 = "no
+redirect"); ids are what verdict cells and CT entries carry. The tensors let
+the device match a tokenized request (method id, padded path bytes) against
+every rule of a set with one vectorized compare:
+
+  methods   [n_sets+1, R]      uint8   (255 = any method)
+  path      [n_sets+1, R, 64]  uint8   (prefix bytes, zero-padded)
+  path_len  [n_sets+1, R]      int32
+  valid     [n_sets+1, R]      bool
+
+match(set_id, m, p) = any_r(valid & (methods==255|methods==m)
+                            & prefix_eq(path[r], p, path_len[r]))
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Tuple
+
+import numpy as np
+
+from cilium_tpu.model.rules import HTTPRule
+from cilium_tpu.utils import constants as C
+
+
+class L7SetInterner:
+    def __init__(self):
+        self._index: Dict[FrozenSet[HTTPRule], int] = {}
+        self.sets: List[FrozenSet[HTTPRule]] = []
+
+    def intern(self, rules: FrozenSet[HTTPRule]) -> int:
+        idx = self._index.get(rules)
+        if idx is None:
+            self.sets.append(rules)
+            idx = len(self.sets)           # 1-based; 0 = none
+            self._index[rules] = idx
+        return idx
+
+
+@dataclass(frozen=True)
+class L7Tensors:
+    methods: np.ndarray     # [n_sets+1, R] uint8
+    path: np.ndarray        # [n_sets+1, R, L7_PATH_MAXLEN] uint8
+    path_len: np.ndarray    # [n_sets+1, R] int32
+    valid: np.ndarray       # [n_sets+1, R] bool
+    n_sets: int
+
+    @property
+    def max_rules(self) -> int:
+        return self.methods.shape[1]
+
+
+def build_l7_tensors(interner: L7SetInterner) -> L7Tensors:
+    n_sets = len(interner.sets)
+    max_rules = max((len(s) for s in interner.sets), default=1) or 1
+    L = C.L7_PATH_MAXLEN
+    methods = np.full((n_sets + 1, max_rules), C.HTTP_METHOD_ANY, dtype=np.uint8)
+    path = np.zeros((n_sets + 1, max_rules, L), dtype=np.uint8)
+    path_len = np.zeros((n_sets + 1, max_rules), dtype=np.int32)
+    valid = np.zeros((n_sets + 1, max_rules), dtype=bool)
+    for set_id, rules in enumerate(interner.sets, start=1):
+        # deterministic rule order (matching is any(), order irrelevant, but
+        # determinism keeps snapshots diffable)
+        ordered = sorted(rules, key=lambda h: (h.method, h.path))
+        for r, rule in enumerate(ordered):
+            methods[set_id, r] = (C.HTTP_METHOD_IDS[rule.method]
+                                  if rule.method else C.HTTP_METHOD_ANY)
+            pb = rule.path.encode()
+            path[set_id, r, :len(pb)] = np.frombuffer(pb, dtype=np.uint8)
+            path_len[set_id, r] = len(pb)
+            valid[set_id, r] = True
+    return L7Tensors(methods=methods, path=path, path_len=path_len,
+                     valid=valid, n_sets=n_sets)
+
+
+def l7_match_host(t: L7Tensors, set_id: int, method: int, path: bytes) -> bool:
+    """Host reference of the tensor match (tests; must agree with
+    oracle.datapath.l7_match and the jnp kernel)."""
+    if set_id <= 0:
+        return True
+    pbuf = np.zeros(C.L7_PATH_MAXLEN, dtype=np.uint8)
+    pb = path[:C.L7_PATH_MAXLEN]
+    pbuf[:len(pb)] = np.frombuffer(pb, dtype=np.uint8)
+    for r in range(t.max_rules):
+        if not t.valid[set_id, r]:
+            continue
+        m = t.methods[set_id, r]
+        if m != C.HTTP_METHOD_ANY and m != method:
+            continue
+        n = int(t.path_len[set_id, r])
+        if n > len(path):
+            continue
+        if (t.path[set_id, r, :n] == pbuf[:n]).all():
+            return True
+    return False

@@ -1,0 +1,133 @@
+"""ipcache → stride-8 multibit trie tensors (the LPM "map").
+
+Replaces the kernel's LPM_TRIE map (upstream ``pkg/maps/ipcache``; datapath
+lookup in ``bpf/lib/eps.h``) with gather-chain tables: one trie per address
+family (mirroring upstream's separate v4/v6 maps), stride 8 bits, so an IPv4
+lookup is 4 dependent gathers and IPv6 is 16 — cost independent of prefix
+count (SURVEY.md §5: "LPM over 100k prefixes as multi-level stride tables").
+
+Node layout: ``nodes[n, 256, 2] int32`` —
+  ``nodes[x, b, 0]`` = child node index, or -1 (no child);
+  ``nodes[x, b, 1]`` = identity *index* decided at this byte, or -1 (inherit
+  the best match seen so far along the path).
+A sentinel "dead" node of all -1 lets the fixed-depth device loop run to full
+depth without data-dependent control flow: after a path ends, the gather
+chain idles in the dead node. Misses resolve to ``default_index``
+(reserved:world), matching the datapath's WORLD_ID fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from cilium_tpu.utils.ip import parse_prefix
+
+V4_LEVELS = 4     # bytes 12..15 of the v4-mapped address
+V6_LEVELS = 16
+
+
+@dataclass(frozen=True)
+class LPMTables:
+    """Host-built trie tensors for one snapshot."""
+    v4_nodes: np.ndarray   # [n4, 256, 2] int32
+    v6_nodes: np.ndarray   # [n6, 256, 2] int32
+    default_index: int     # identity index for LPM miss (world)
+
+    @property
+    def nbytes(self) -> int:
+        return self.v4_nodes.nbytes + self.v6_nodes.nbytes
+
+
+class _TrieBuilder:
+    def __init__(self):
+        # node 0 is the root; each node is {byte: child_idx} + per-byte value
+        self.children: List[Dict[int, int]] = [{}]
+        self.values: List[Dict[int, int]] = [{}]
+
+    def _new_node(self) -> int:
+        self.children.append({})
+        self.values.append({})
+        return len(self.children) - 1
+
+    def insert(self, addr_bytes: bytes, plen_bits: int, value: int) -> None:
+        """Insert a prefix of ``plen_bits`` (multiple-of-8 boundary handled by
+        expansion: a /12 covers 2^(16-12)=16 byte-values at level 2)."""
+        node = 0
+        full_bytes, rem_bits = divmod(plen_bits, 8)
+        for level in range(full_bytes):
+            b = addr_bytes[level]
+            if level == full_bytes - 1 and rem_bits == 0:
+                old = self.values[node].get(b)
+                if old is None or old[0] <= plen_bits:
+                    self.values[node][b] = (plen_bits, value)
+                return
+            child = self.children[node].get(b)
+            if child is None:
+                child = self._new_node()
+                self.children[node][b] = child
+            node = child
+        # partial byte: expand the remaining bits over the byte range
+        b0 = addr_bytes[full_bytes] & (0xFF << (8 - rem_bits)) if rem_bits else 0
+        span = 1 << (8 - rem_bits) if rem_bits else 256
+        for b in range(b0, b0 + span):
+            old = self.values[node].get(b)
+            if old is None or old[0] <= plen_bits:
+                self.values[node][b] = (plen_bits, value)
+
+    def to_array(self) -> np.ndarray:
+        n = len(self.children)
+        arr = np.full((n + 1, 256, 2), -1, dtype=np.int32)  # +1 dead node
+        for idx in range(n):
+            for b, child in self.children[idx].items():
+                arr[idx, b, 0] = child
+            for b, (_plen, value) in self.values[idx].items():
+                arr[idx, b, 1] = value
+        return arr
+
+    @property
+    def dead_node(self) -> int:
+        return len(self.children)
+
+
+def build_lpm(ipcache_entries: Dict[str, int],
+              identity_index: Dict[int, int],
+              default_index: int) -> LPMTables:
+    """Build trie tensors from an ipcache snapshot.
+
+    ``identity_index`` maps identity id → dense index (the LPM leaf payload);
+    entries referencing unknown identities raise (the compiler must be handed
+    a consistent snapshot).
+    """
+    b4, b6 = _TrieBuilder(), _TrieBuilder()
+    for prefix, ident in ipcache_entries.items():
+        addr16, plen, is_v6 = parse_prefix(prefix)
+        idx = identity_index[ident]
+        if is_v6:
+            b6.insert(addr16, plen, idx)
+        else:
+            # v4: trie over the last 4 bytes; /96+p → p bits here
+            b4.insert(addr16[12:], plen - 96, idx)
+    return LPMTables(v4_nodes=b4.to_array(), v6_nodes=b6.to_array(),
+                     default_index=default_index)
+
+
+def lpm_lookup_host(tables: LPMTables, addr16: bytes, is_v6: bool) -> int:
+    """Host-side reference walk of the trie tensors (for tests; the jnp
+    kernel in kernels/lpm.py must agree with this AND with
+    model.ipcache.lpm_lookup)."""
+    nodes = tables.v6_nodes if is_v6 else tables.v4_nodes
+    data = addr16 if is_v6 else addr16[12:]
+    levels = V6_LEVELS if is_v6 else V4_LEVELS
+    node = 0
+    dead = nodes.shape[0] - 1
+    best = tables.default_index
+    for level in range(levels):
+        b = data[level]
+        child, value = nodes[node, b]
+        if value >= 0:
+            best = int(value)
+        node = int(child) if child >= 0 else dead
+    return best

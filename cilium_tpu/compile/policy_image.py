@@ -1,0 +1,154 @@
+"""MapState → dense verdict tensors: the precedence ladder resolved at
+compile time.
+
+The datapath ladder (deny-wins → most-specific allow → default) is evaluated
+ONCE per (id_class, port_class) cell here, so the device lookup is two
+gathers (class maps) + one gather (cell) instead of a wildcard-ladder walk —
+the TPU-first replacement for per-packet policymap probing
+(upstream: ``bpf/lib/policy.h`` policy_can_access).
+
+Cell encoding (uint16): low 2 bits = decision (MISS/ALLOW/DENY/REDIRECT),
+high 14 bits = L7 set id for REDIRECT cells.
+
+Equivalence with the sparse ladder is by construction:
+- deny entries are OR-accumulated into a deny mask (deny wins regardless of
+  rank, mirroring MapState.lookup);
+- allow entries compete per cell on the scalar rank (see
+  policy.mapstate.rank_scalar — order-isomorphic to the ladder's tie-break
+  for same-cell candidates);
+and is additionally test-enforced cell-by-cell against MapState.lookup.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+from cilium_tpu.compile.idclass import IdentityClasses
+from cilium_tpu.compile.l7 import L7SetInterner
+from cilium_tpu.compile.portclass import PortClassTable
+from cilium_tpu.policy.mapstate import MapState, rank_scalar
+from cilium_tpu.policy.repository import EndpointPolicy
+from cilium_tpu.utils import constants as C
+
+
+@dataclass(frozen=True)
+class PolicyImage:
+    """Dense verdict state for all endpoints of one snapshot."""
+    verdict: np.ndarray    # [n_eps, 2, n_id_classes, n_port_classes] uint16
+    enforced: np.ndarray   # [n_eps, 2] bool
+
+    @property
+    def nbytes(self) -> int:
+        return self.verdict.nbytes + self.enforced.nbytes
+
+
+def build_policy_image(
+    policies: List[EndpointPolicy],      # index == ep slot
+    id_classes: IdentityClasses,
+    port_classes: PortClassTable,
+    l7: L7SetInterner,
+) -> PolicyImage:
+    n_eps = len(policies)
+    n_rows = id_classes.n_classes
+    n_cols = port_classes.n_classes
+    verdict = np.zeros((n_eps, 2, n_rows, n_cols), dtype=np.uint16)
+    enforced = np.zeros((n_eps, 2), dtype=bool)
+
+    for slot, pol in enumerate(policies):
+        for direction, dirpol in ((C.DIR_EGRESS, pol.egress),
+                                  (C.DIR_INGRESS, pol.ingress)):
+            enforced[slot, direction] = dirpol.enforced
+            if not dirpol.enforced:
+                # Unenforced direction = allow-all: the oracle skips the
+                # ladder entirely (even denies), so the plane stays all-MISS
+                # and the kernel's ~enforced MISS path allows. Compiling the
+                # entries anyway would wrongly apply DENY/REDIRECT cells.
+                continue
+            verdict[slot, direction] = _build_plane(
+                dirpol.mapstate, id_classes, port_classes, l7,
+                n_rows, n_cols)
+    return PolicyImage(verdict=verdict, enforced=enforced)
+
+
+def _build_plane(ms: MapState, id_classes: IdentityClasses,
+                 port_classes: PortClassTable, l7: L7SetInterner,
+                 n_rows: int, n_cols: int) -> np.ndarray:
+    deny = np.zeros((n_rows, n_cols), dtype=bool)
+    best_rank = np.full((n_rows, n_cols), -1, dtype=np.int64)
+    allow_val = np.zeros((n_rows, n_cols), dtype=np.uint16)
+
+    for key, entry in ms.items():
+        # rows
+        if key.identity == C.IDENTITY_ANY:
+            rows = None                                   # all rows
+        else:
+            idx = id_classes.index_of.get(key.identity)
+            if idx is None:
+                continue                                  # identity not in snapshot
+            rows = np.asarray([id_classes.class_of[idx]])
+        # cols
+        if key.proto == C.PROTO_ANY:
+            cols = None                                   # all columns
+        else:
+            fam = C.proto_family(key.proto)
+            if fam == C.PROTO_FAMILY_OTHER:
+                # The dense image can only represent proto-exact semantics
+                # for protocols with their own family; a proto-specific entry
+                # for e.g. GRE would silently conflate with every other
+                # OTHER-family protocol. The rule parser never emits these;
+                # reject rather than mis-compile.
+                raise ValueError(
+                    f"cannot compile proto-specific entry for protocol "
+                    f"{key.proto} (no dedicated proto family)")
+            cols = port_classes.classes_for_range(fam, key.port_lo, key.port_hi)
+            if cols.size == 0:
+                continue
+
+        if entry.deny:
+            _write_mask(deny, rows, cols, True)
+            continue
+
+        if entry.l7_rules is not None:
+            cell = C.verdict_cell(C.VERDICT_REDIRECT, l7.intern(entry.l7_rules))
+        else:
+            cell = C.verdict_cell(C.VERDICT_ALLOW)
+        rank = rank_scalar(key)
+        _write_ranked(best_rank, allow_val, rows, cols, rank, cell)
+
+    out = allow_val.copy()
+    out[best_rank < 0] = C.VERDICT_MISS
+    out[deny] = C.verdict_cell(C.VERDICT_DENY)
+    return out
+
+
+def _write_mask(arr: np.ndarray, rows, cols, value) -> None:
+    if rows is None and cols is None:
+        arr[:, :] = value
+    elif rows is None:
+        arr[:, cols] = value
+    elif cols is None:
+        arr[rows, :] = value
+    else:
+        arr[np.ix_(rows, cols)] = value
+
+
+def _write_ranked(best_rank: np.ndarray, val: np.ndarray, rows, cols,
+                  rank: int, cell: int) -> None:
+    """best-rank-wins scatter. Ranks of distinct keys covering the same cell
+    are distinct (see rank_scalar), so no equal-rank conflicts exist."""
+    if rows is None:
+        rows = np.arange(best_rank.shape[0])
+    if cols is None:
+        cols = np.arange(best_rank.shape[1])
+    ix = np.ix_(rows, cols)
+    sub = best_rank[ix]
+    m = rank > sub
+    if m.any():
+        sub[m] = rank
+        best_rank[ix] = sub
+        vsub = val[ix]
+        vsub[m] = cell
+        val[ix] = vsub

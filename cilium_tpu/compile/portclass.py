@@ -1,0 +1,58 @@
+"""Port equivalence classes (the compile-time half of the policymap key).
+
+All L4 port ranges appearing in any MapState entry partition the 0..65535
+space per proto family into equivalence classes: two ports in the same class
+are covered by exactly the same set of entries, so the dense verdict tensor
+needs one column per class, not per port. Classes are globally numbered
+across families (each family owns a contiguous class range), giving the
+device a single ``class = table[family, dport]`` gather.
+
+This is the classic bitmap/equivalence-class trick from packet-classification
+literature, applied at compile time so the TPU lookup is O(1) gathers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.utils import constants as C
+
+
+@dataclass(frozen=True)
+class PortClassTable:
+    table: np.ndarray          # [N_PROTO_FAMILIES, 65536] int32 → global class
+    n_classes: int
+    # per family: list of (lo, hi) covered by each local class, for inspection
+    family_class_ranges: Tuple[Tuple[Tuple[int, int], ...], ...]
+
+    def classes_for_range(self, family: int, lo: int, hi: int) -> np.ndarray:
+        """Global class ids intersecting [lo, hi] in ``family`` (sorted)."""
+        return np.unique(self.table[family, lo:hi + 1])
+
+
+def build_port_classes(
+    ranges_by_family: Dict[int, Iterable[Tuple[int, int]]],
+) -> PortClassTable:
+    """``ranges_by_family[family]`` = all (lo, hi) port ranges any entry uses
+    in that family (wildcard (0, 65535) need not be included — it maps to
+    every class anyway)."""
+    table = np.zeros((C.N_PROTO_FAMILIES, 65536), dtype=np.int32)
+    next_class = 0
+    all_ranges: List[Tuple[Tuple[int, int], ...]] = []
+    for family in range(C.N_PROTO_FAMILIES):
+        boundaries = {0, 65536}
+        for lo, hi in ranges_by_family.get(family, ()):  # inclusive ranges
+            boundaries.add(lo)
+            boundaries.add(hi + 1)
+        cuts = sorted(b for b in boundaries if 0 <= b <= 65536)
+        fam_ranges: List[Tuple[int, int]] = []
+        for lo, hi_excl in zip(cuts[:-1], cuts[1:]):
+            table[family, lo:hi_excl] = next_class
+            fam_ranges.append((lo, hi_excl - 1))
+            next_class += 1
+        all_ranges.append(tuple(fam_ranges))
+    return PortClassTable(table=table, n_classes=next_class,
+                          family_class_ranges=tuple(all_ranges))

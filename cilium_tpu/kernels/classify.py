@@ -1,0 +1,136 @@
+"""The fused classify step — one jitted program per batch (the analog of one
+eBPF datapath run over a batch of packets; SURVEY.md §3.3: "TPU equivalent:
+one fused kernel: gather(ipcache-LPM) → conntrack probe → policy
+wildcard-ladder as masked [compile-time] resolution → verdict + CT update,
+batched over N headers").
+
+Branch-free: every packet takes every path, masks select. XLA fuses the
+elementwise pipeline between the gathers; the scatters at the end form the
+CT write phase.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cilium_tpu.compile.ct_layout import PROBE_DEPTH
+from cilium_tpu.kernels import conntrack as ctk
+from cilium_tpu.kernels.l7 import l7_match_batch
+from cilium_tpu.kernels.lpm import lpm_lookup_batch
+from cilium_tpu.kernels.policy import policy_lookup_batch
+from cilium_tpu.utils import constants as C
+
+N_REASON_BINS = 256
+
+
+def classify_step(tensors, ct, batch, now, *, world_index: int = 0,
+                  probe_depth: int = PROBE_DEPTH, v4_only: bool = False):
+    """→ (out, new_ct, counters).
+
+    out: allow [N] bool, reason [N] int32 (DropReason), status [N] int32
+    (CTStatus), remote_identity [N] uint32, redirect [N] bool.
+    counters: by_reason_dir [512] uint32, insert_fail uint32 scalar.
+    """
+    valid = batch["valid"]
+    direction = batch["direction"]
+
+    # 1. ipcache LPM: remote = dst on egress, src on ingress
+    remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
+                             batch["dst"], batch["src"])
+    id_idx = lpm_lookup_batch(tensors["lpm_v4"], tensors["lpm_v6"],
+                              remote_words, batch["is_v6"],
+                              default_index=world_index, v4_only=v4_only)
+    remote_identity = tensors["identity_ids"][id_idx].astype(jnp.uint32)
+
+    # 2. conntrack probe (batch-start snapshot)
+    fwd_keys = ctk.ct_key_words_jnp(batch, reverse=False)
+    rev_keys = ctk.ct_key_words_jnp(batch, reverse=True)
+    fwd_slot = ctk.ct_probe(ct, fwd_keys, now, probe_depth)
+    rev_slot = ctk.ct_probe(ct, rev_keys, now, probe_depth)
+    est = valid & (fwd_slot >= 0)
+    reply = valid & ~est & (rev_slot >= 0)
+    new = valid & ~est & ~reply
+    hit = est | reply
+    hit_slot = jnp.where(est, fwd_slot, jnp.where(reply, rev_slot, 0))
+    l7_of_hit = jnp.where(hit, ct["l7_id"][hit_slot].astype(jnp.int32), 0)
+
+    # 3. policy (ladder already resolved into the dense image)
+    decision, l7_new, enforced = policy_lookup_batch(
+        tensors, batch["ep_slot"], direction, id_idx,
+        batch["proto"], batch["dport"])
+    is_redirect_new = new & (decision == C.VERDICT_REDIRECT)
+
+    # 4. L7-lite: one match evaluation covers hit-flows and new redirects
+    has_tokens = (batch["http_method"] != C.HTTP_METHOD_ANY) \
+        | (batch["http_path"] != 0).any(axis=-1)
+    set_to_check = jnp.where(hit, l7_of_hit,
+                             jnp.where(is_redirect_new, l7_new, 0))
+    l7_ok = l7_match_batch(tensors, set_to_check, batch["http_method"],
+                           batch["http_path"])
+    l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
+
+    # 5. verdict composition (mirrors oracle classify())
+    new_allow = jnp.where(
+        decision == C.VERDICT_DENY, False,
+        jnp.where(decision == C.VERDICT_MISS, ~enforced,
+                  ~l7_fail))  # ALLOW always passes; REDIRECT unless l7_fail
+    allow = jnp.where(hit, ~l7_fail, new_allow) & valid
+    reason = jnp.where(
+        hit,
+        jnp.where(l7_fail, int(C.DropReason.POLICY_L7), int(C.DropReason.OK)),
+        jnp.where(
+            decision == C.VERDICT_DENY, int(C.DropReason.POLICY_DENY),
+            jnp.where(decision == C.VERDICT_MISS,
+                      jnp.where(enforced, int(C.DropReason.POLICY),
+                                int(C.DropReason.OK)),
+                      jnp.where(l7_fail, int(C.DropReason.POLICY_L7),
+                                int(C.DropReason.OK)))),
+    ).astype(jnp.int32)
+    status = jnp.where(est, int(C.CTStatus.ESTABLISHED),
+                       jnp.where(reply, int(C.CTStatus.REPLY),
+                                 int(C.CTStatus.NEW))).astype(jnp.int32)
+    redirect = (hit & (l7_of_hit > 0)) | is_redirect_new
+
+    # 6. CT insert for allowed new flows, then aggregate effects
+    want_insert = new & allow
+    l7_entry = jnp.where(is_redirect_new, l7_new, 0)
+    new_keys, new_l7, new_created, zero_mask, slot_new, fail = \
+        ctk.ct_insert_new(ct, fwd_keys, want_insert, l7_entry, now, probe_depth)
+    slot = jnp.where(hit, hit_slot, slot_new)
+    contrib = allow & (jnp.where(hit, True, slot_new >= 0))
+    new_ct = ctk.ct_apply(ct, batch, slot, reply, contrib, now,
+                          new_keys=new_keys, new_l7=new_l7,
+                          new_created=new_created, zero_mask=zero_mask)
+
+    # 7. counters (metricsmap analog: per reason × direction)
+    bin_idx = reason * 2 + direction
+    scat = jnp.where(valid, bin_idx, N_REASON_BINS * 2)
+    by_reason_dir = jnp.zeros((N_REASON_BINS * 2,), dtype=jnp.uint32).at[scat].add(
+        jnp.uint32(1), mode="drop")
+    counters = {
+        "by_reason_dir": by_reason_dir,
+        "insert_fail": fail.sum().astype(jnp.uint32),
+    }
+
+    out = {
+        "allow": allow,
+        "reason": reason,
+        "status": status,
+        "remote_identity": remote_identity,
+        "redirect": redirect,
+    }
+    return out, new_ct, counters
+
+
+def make_classify_fn(world_index: int, probe_depth: int = PROBE_DEPTH,
+                     v4_only: bool = False, donate_ct: bool = True):
+    """jit-compiled classify step with the snapshot's static geometry baked
+    in. CT buffers are donated (in-place update, no double allocation)."""
+    def fn(tensors, ct, batch, now):
+        return classify_step(tensors, ct, batch, now,
+                             world_index=world_index,
+                             probe_depth=probe_depth, v4_only=v4_only)
+    return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

@@ -1,0 +1,212 @@
+"""Device conntrack: batched probe, deterministic parallel insert, aggregate
+update, epoch sweep (upstream: bpf/lib/conntrack.h + pkg/maps/ctmap GC).
+
+Design (SURVEY.md §7 "Hash tables on TPU"): fixed-capacity open addressing,
+``PROBE_DEPTH`` linear probe slots, structure-of-arrays (compile/ct_layout).
+All updates follow the *snapshot* batch semantics the oracle defines
+(oracle/datapath.py classify_batch_snapshot): verdicts read the batch-start
+state; effects are applied as an order-independent aggregate (flag-bit OR via
+per-bit scatter-max, counter scatter-adds, expiry recomputed from aggregated
+flags). Inserts resolve conflicts deterministically: per probe round, the
+lowest packet index wins a free slot (scatter-min claim), duplicates of an
+inserted key adopt the entry on the next round's check. Packets whose insert
+exhausts all probe slots are counted (``insert_fail``) and still forwarded —
+tracking fails open, policy never does.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cilium_tpu.compile.ct_layout import KEY_WORDS, PROBE_DEPTH
+from cilium_tpu.kernels.hashing import hash_words_jnp
+from cilium_tpu.utils import constants as C
+
+
+def ct_key_words_jnp(batch, reverse: bool = False):
+    """jnp mirror of kernels.records.ct_key_words."""
+    src, dst = ((batch["dst"], batch["src"]) if reverse
+                else (batch["src"], batch["dst"]))
+    sport, dport = ((batch["dport"], batch["sport"]) if reverse
+                    else (batch["sport"], batch["dport"]))
+    direction = ((1 - batch["direction"]) if reverse else batch["direction"])
+    words = [
+        src[:, 0], src[:, 1], src[:, 2], src[:, 3],
+        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
+        (sport.astype(jnp.uint32) << jnp.uint32(16)) | dport.astype(jnp.uint32),
+        (batch["proto"].astype(jnp.uint32) << jnp.uint32(8))
+        | direction.astype(jnp.uint32),
+    ]
+    return jnp.stack(words, axis=-1)
+
+
+def ct_probe(ct, keys, now, probe_depth: int = PROBE_DEPTH):
+    """Find each key's live slot. → slot [N] int32 (-1 = miss)."""
+    cap = ct["expiry"].shape[0]
+    mask = cap - 1
+    base = (hash_words_jnp(keys) & jnp.uint32(mask)).astype(jnp.int32)
+    found = jnp.full(base.shape, -1, dtype=jnp.int32)
+    for i in range(probe_depth):
+        s = (base + i) & mask
+        live = ct["expiry"][s] > now
+        eq = jnp.all(ct["keys"][s] == keys, axis=-1) & live
+        found = jnp.where((found < 0) & eq, s, found)
+    return found
+
+
+def _flag_delta(proto, tcp_flags, is_reply):
+    """Vectorized mirror of oracle._flag_delta."""
+    is_tcp = proto == C.PROTO_TCP
+    fin_rst = (tcp_flags & (C.TCP_FIN | C.TCP_RST)) != 0
+    rst = (tcp_flags & C.TCP_RST) != 0
+    non_syn = (tcp_flags & C.TCP_SYN) == 0
+    close_self = jnp.where(is_reply, C.CT_FLAG_RX_CLOSING, C.CT_FLAG_TX_CLOSING)
+    delta = jnp.where(fin_rst, close_self, 0)
+    delta = jnp.where(rst, delta | C.CT_FLAG_TX_CLOSING | C.CT_FLAG_RX_CLOSING,
+                      delta)
+    delta = jnp.where(non_syn, delta | C.CT_FLAG_SEEN_NON_SYN, delta)
+    return jnp.where(is_tcp, delta, 0).astype(jnp.uint32)
+
+
+def _lifetime(proto, flags):
+    """Vectorized mirror of oracle lifetime rules. proto [M], flags [M]."""
+    is_tcp = proto == C.PROTO_TCP
+    closing = (flags & (C.CT_FLAG_TX_CLOSING | C.CT_FLAG_RX_CLOSING)) != 0
+    non_syn = (flags & C.CT_FLAG_SEEN_NON_SYN) != 0
+    tcp_life = jnp.where(closing, C.CT_LIFETIME_CLOSE,
+                         jnp.where(non_syn, C.CT_LIFETIME_TCP,
+                                   C.CT_LIFETIME_SYN))
+    return jnp.where(is_tcp, tcp_life, C.CT_LIFETIME_NONTCP).astype(jnp.uint32)
+
+
+def ct_insert_new(ct, keys, want_insert, l7_id, now,
+                  probe_depth: int = PROBE_DEPTH):
+    """Deterministic parallel insert of new flows.
+
+    Returns (new_keys, new_l7, new_created, zero_mask, slot_of, fail):
+    - ``zero_mask`` [cap] marks freshly-claimed slots whose value arrays
+      (flags/counters) must be reset before aggregation;
+    - ``slot_of`` [N] is the entry slot for every packet whose flow now has
+      one (winner or adopted duplicate), else -1;
+    - ``fail`` [N] marks flows that exhausted their probe window.
+    """
+    cap = ct["expiry"].shape[0]
+    mask = cap - 1
+    n = keys.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    base = (hash_words_jnp(keys) & jnp.uint32(mask)).astype(jnp.int32)
+
+    keys_arr = ct["keys"]
+    l7_arr = ct["l7_id"]
+    created_arr = ct["created"]
+    claimed = jnp.zeros((cap,), dtype=bool)
+    zero_mask = jnp.zeros((cap,), dtype=bool)
+    slot_of = jnp.full((n,), -1, dtype=jnp.int32)
+    pending = want_insert
+
+    for r in range(probe_depth):
+        if r > 0:
+            # adoption: my previous round's target may now hold my key,
+            # inserted by a lower-indexed duplicate of my flow
+            sprev = (base + (r - 1)) & mask
+            adopted = (pending & claimed[sprev]
+                       & jnp.all(keys_arr[sprev] == keys, axis=-1))
+            slot_of = jnp.where(adopted, sprev, slot_of)
+            pending = pending & ~adopted
+        s = (base + r) & mask
+        free = (ct["expiry"][s] <= now) & ~claimed[s]
+        attempt = pending & free
+        # lowest packet index wins each contested slot
+        scat = jnp.where(attempt, s, cap)
+        claim = jnp.full((cap + 1,), n, dtype=jnp.int32).at[scat].min(idx)
+        winner = attempt & (claim[s] == idx)
+        ws = jnp.where(winner, s, cap)
+        keys_arr = keys_arr.at[ws].set(keys, mode="drop")
+        l7_arr = l7_arr.at[ws].set(l7_id.astype(jnp.uint32), mode="drop")
+        created_arr = created_arr.at[ws].set(now, mode="drop")
+        claimed = claimed.at[ws].set(True, mode="drop")
+        zero_mask = zero_mask.at[ws].set(True, mode="drop")
+        slot_of = jnp.where(winner, s, slot_of)
+        pending = pending & ~winner
+
+    # final adoption sweep: stragglers whose duplicate won at a slot they
+    # already passed
+    for r in range(probe_depth):
+        s = (base + r) & mask
+        adopted = (pending & claimed[s]
+                   & jnp.all(keys_arr[s] == keys, axis=-1))
+        slot_of = jnp.where(adopted, s, slot_of)
+        pending = pending & ~adopted
+
+    return keys_arr, l7_arr, created_arr, zero_mask, slot_of, pending
+
+
+def ct_apply(ct, batch, slot, is_reply, contrib, now,
+             new_keys=None, new_l7=None, new_created=None, zero_mask=None):
+    """Aggregate all allowed packets' effects into the table (snapshot
+    semantics). ``slot`` [N] (-1 = none), ``contrib`` [N] bool.
+
+    Returns the new ct pytree.
+    """
+    cap = ct["expiry"].shape[0]
+    keys_arr = new_keys if new_keys is not None else ct["keys"]
+    l7_arr = new_l7 if new_l7 is not None else ct["l7_id"]
+    created_arr = new_created if new_created is not None else ct["created"]
+    flags = ct["flags"]
+    fwd = ct["pkts_fwd"]
+    rev = ct["pkts_rev"]
+    if zero_mask is not None:
+        zero32 = jnp.uint32(0)
+        flags = jnp.where(zero_mask, zero32, flags)
+        fwd = jnp.where(zero_mask, zero32, fwd)
+        rev = jnp.where(zero_mask, zero32, rev)
+
+    scat = jnp.where(contrib, slot, cap)  # OOB → dropped
+    delta = _flag_delta(batch["proto"], batch["tcp_flags"], is_reply)
+    # OR-accumulate flag bits: scatter-max each bit plane separately (a max
+    # on the full word would clobber unrelated bits), then recombine
+    acc = jnp.zeros_like(flags)
+    for bit in (C.CT_FLAG_SEEN_NON_SYN, C.CT_FLAG_TX_CLOSING,
+                C.CT_FLAG_RX_CLOSING):
+        plane = flags & jnp.uint32(bit)
+        has = ((delta & jnp.uint32(bit)) != 0).astype(jnp.uint32) * jnp.uint32(bit)
+        plane = plane.at[scat].max(has, mode="drop")
+        acc = acc | plane
+    flags = acc
+    one = jnp.ones_like(scat, dtype=jnp.uint32)
+    fwd = fwd.at[jnp.where(contrib & ~is_reply, slot, cap)].add(one, mode="drop")
+    rev = rev.at[jnp.where(contrib & is_reply, slot, cap)].add(one, mode="drop")
+
+    touched = jnp.zeros((cap,), dtype=bool).at[scat].set(True, mode="drop")
+    slot_proto = (keys_arr[:, 9] >> jnp.uint32(8)).astype(jnp.int32)
+    new_expiry = now + _lifetime(slot_proto, flags)
+    expiry = jnp.where(touched, new_expiry, ct["expiry"])
+
+    return {
+        "keys": keys_arr,
+        "expiry": expiry,
+        "created": created_arr,
+        "flags": flags,
+        "l7_id": l7_arr,
+        "pkts_fwd": fwd,
+        "pkts_rev": rev,
+    }
+
+
+def ct_sweep(ct, now):
+    """Epoch GC: clear expired entries (upstream ctmap GC — SURVEY.md §2
+    "Pipelined device-side epoch sweep"). Returns (new_ct, n_reclaimed)."""
+    dead = (ct["expiry"] <= now) & (ct["expiry"] != 0)
+    zero32 = jnp.uint32(0)
+    new_ct = dict(ct)
+    new_ct["expiry"] = jnp.where(dead, zero32, ct["expiry"])
+    new_ct["keys"] = jnp.where(dead[:, None], zero32, ct["keys"])
+    new_ct["flags"] = jnp.where(dead, zero32, ct["flags"])
+    new_ct["l7_id"] = jnp.where(dead, zero32, ct["l7_id"])
+    new_ct["pkts_fwd"] = jnp.where(dead, zero32, ct["pkts_fwd"])
+    new_ct["pkts_rev"] = jnp.where(dead, zero32, ct["pkts_rev"])
+    new_ct["created"] = jnp.where(dead, zero32, ct["created"])
+    return new_ct, dead.sum()

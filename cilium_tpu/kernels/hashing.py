@@ -1,0 +1,51 @@
+"""32-bit mixing hash over conntrack key words.
+
+One definition, two executors: the jnp version runs inside the classify
+kernel; the numpy version is the host mirror (checkpoint export/import and
+tests). They must agree bit-for-bit — test-enforced on random keys.
+
+The mix is a murmur3-style accumulate + fmix32 finalizer: good avalanche,
+cheap on the VPU (shifts/xors/mults on uint32 lanes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_SEED = 0x9747B28C
+
+
+def _rotl32(xp, x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _hash_words_generic(xp, words):
+    """words: [..., K] uint32 → [...] uint32."""
+    words = words.astype(xp.uint32)
+    h = xp.full(words.shape[:-1], _SEED, dtype=xp.uint32)
+    for i in range(words.shape[-1]):
+        k = words[..., i] * np.uint32(_C1)
+        k = _rotl32(xp, k, 15)
+        k = k * np.uint32(_C2)
+        h = h ^ k
+        h = _rotl32(xp, h, 13)
+        h = h * np.uint32(5) + np.uint32(0xE6546B64)
+    # fmix32
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def hash_words_np(words: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        return _hash_words_generic(np, np.asarray(words))
+
+
+def hash_words_jnp(words):
+    import jax.numpy as jnp
+    return _hash_words_generic(jnp, words)

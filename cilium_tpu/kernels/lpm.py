@@ -1,0 +1,44 @@
+"""Batched LPM trie walk (the device half of compile/lpm.py).
+
+Fixed-depth gather chain, no data-dependent control flow: dead paths idle in
+the sentinel node. A mixed-family batch walks both tries and selects by the
+family bit (mirroring upstream's two LPM maps); ``v4_only=True`` (static)
+skips the 16-level v6 walk for pure-IPv4 workloads (BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cilium_tpu.compile.lpm import V4_LEVELS, V6_LEVELS
+
+
+def _walk(nodes, addr_words, byte_index, levels, default_index):
+    """nodes [n,256,2] int32; addr_words [N,4] uint32; byte_index(l) gives the
+    byte position 0..15 in the 16-byte address for level l."""
+    n_nodes = nodes.shape[0]
+    dead = n_nodes - 1
+    n = addr_words.shape[0]
+    node = jnp.zeros((n,), dtype=jnp.int32)
+    best = jnp.full((n,), default_index, dtype=jnp.int32)
+    for level in range(levels):
+        pos = byte_index(level)
+        word = addr_words[:, pos // 4]
+        b = ((word >> jnp.uint32(8 * (3 - pos % 4))) & jnp.uint32(0xFF)
+             ).astype(jnp.int32)
+        pair = nodes[node, b]                     # [N, 2]
+        child, value = pair[:, 0], pair[:, 1]
+        best = jnp.where(value >= 0, value, best)
+        node = jnp.where(child >= 0, child, dead)
+    return best
+
+
+def lpm_lookup_batch(lpm_v4, lpm_v6, addr_words, is_v6, default_index: int,
+                     v4_only: bool = False):
+    """addr_words [N,4] uint32 (16-byte normalized, v4-mapped) → identity
+    index [N] int32."""
+    r4 = _walk(lpm_v4, addr_words, lambda l: 12 + l, V4_LEVELS, default_index)
+    if v4_only:
+        return r4
+    r6 = _walk(lpm_v6, addr_words, lambda l: l, V6_LEVELS, default_index)
+    return jnp.where(is_v6, r6, r4)

@@ -1,0 +1,21 @@
+"""Dense policy lookup: the whole wildcard ladder in three gathers
+(upstream: bpf/lib/policy.h policy_can_access's 6-lookup ladder, resolved at
+compile time by compile/policy_image.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cilium_tpu.utils import constants as C
+
+
+def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport):
+    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool)."""
+    id_cls = tensors["id_class_of"][id_index]
+    fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
+    pcls = tensors["port_class"][fam, jnp.clip(dport, 0, 65535)]
+    cell = tensors["verdict"][ep_slot, direction, id_cls, pcls].astype(jnp.int32)
+    enforced = tensors["enforced"][ep_slot, direction]
+    decision = cell & C.VERDICT_DECISION_MASK
+    l7_id = cell >> C.VERDICT_L7_SHIFT
+    return decision, l7_id, enforced

@@ -1,0 +1,76 @@
+"""Packet batch layout: the device-facing form of the shim's 64B records.
+
+A batch is a dict-of-arrays pytree with a fixed size N (padded; ``valid``
+masks real packets). The C++ shim emits exactly these columns (shim/ record
+layout doc); tests build batches from oracle PacketRecords.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from cilium_tpu.utils import constants as C
+
+BatchArrays = Dict[str, np.ndarray]
+
+
+def empty_batch(n: int) -> BatchArrays:
+    return {
+        "src": np.zeros((n, 4), dtype=np.uint32),
+        "dst": np.zeros((n, 4), dtype=np.uint32),
+        "sport": np.zeros((n,), dtype=np.int32),
+        "dport": np.zeros((n,), dtype=np.int32),
+        "proto": np.zeros((n,), dtype=np.int32),
+        "tcp_flags": np.zeros((n,), dtype=np.int32),
+        "is_v6": np.zeros((n,), dtype=bool),
+        "ep_slot": np.zeros((n,), dtype=np.int32),
+        "direction": np.zeros((n,), dtype=np.int32),
+        "http_method": np.full((n,), C.HTTP_METHOD_ANY, dtype=np.int32),
+        "http_path": np.zeros((n, C.L7_PATH_MAXLEN), dtype=np.uint8),
+        "valid": np.zeros((n,), dtype=bool),
+    }
+
+
+def _addr_words(addr16: bytes) -> np.ndarray:
+    return np.frombuffer(addr16, dtype=">u4").astype(np.uint32)
+
+
+def batch_from_records(records: Sequence, ep_slot_of: Dict[int, int],
+                       pad_to: int = 0) -> BatchArrays:
+    """Build a batch from oracle PacketRecords (tests / pcap replay)."""
+    n = max(len(records), pad_to)
+    b = empty_batch(n)
+    for i, p in enumerate(records):
+        b["src"][i] = _addr_words(p.src_addr)
+        b["dst"][i] = _addr_words(p.dst_addr)
+        b["sport"][i] = p.src_port
+        b["dport"][i] = p.dst_port
+        b["proto"][i] = p.proto
+        b["tcp_flags"][i] = p.tcp_flags
+        b["is_v6"][i] = p.is_ipv6
+        b["ep_slot"][i] = ep_slot_of[p.ep_id]
+        b["direction"][i] = p.direction
+        b["http_method"][i] = p.http_method
+        pb = p.http_path[:C.L7_PATH_MAXLEN]
+        if pb:
+            b["http_path"][i, :len(pb)] = np.frombuffer(pb, dtype=np.uint8)
+        b["valid"][i] = True
+    return b
+
+
+def ct_key_words(batch: BatchArrays, reverse: bool = False) -> np.ndarray:
+    """[N, 10] uint32 conntrack key (see compile/ct_layout.py), forward or
+    reverse orientation. numpy version; kernels/conntrack.py mirrors in jnp."""
+    src, dst = (batch["dst"], batch["src"]) if reverse else (batch["src"], batch["dst"])
+    sport, dport = ((batch["dport"], batch["sport"]) if reverse
+                    else (batch["sport"], batch["dport"]))
+    direction = (1 - batch["direction"]) if reverse else batch["direction"]
+    n = src.shape[0]
+    words = np.zeros((n, 10), dtype=np.uint32)
+    words[:, 0:4] = src
+    words[:, 4:8] = dst
+    words[:, 8] = (sport.astype(np.uint32) << 16) | dport.astype(np.uint32)
+    words[:, 9] = (batch["proto"].astype(np.uint32) << 8) | direction.astype(np.uint32)
+    return words

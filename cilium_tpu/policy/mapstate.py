@@ -146,8 +146,22 @@ class MapState:
 
 def _rank(key: MapStateKey) -> Tuple[int, int, int, int, int]:
     """Total order for 'most specific wins': higher tuple = more specific.
-    Ties beyond specificity: narrower port range, then higher identity,
-    higher proto, higher port_lo — arbitrary but total and documented, so the
-    oracle, compiler, and trace tool agree bit-for-bit."""
+
+    Ties beyond specificity: narrower port range, then higher port_lo, then
+    identity/proto. The (specificity, -width, port_lo) prefix is *provably
+    total for candidates covering the same packet*: two same-cell candidates
+    with equal specificity share identity (both exact-id on that identity, or
+    both ANY) and proto (proto families don't overlap), so equal width + equal
+    port_lo forces equal port_hi, i.e. the same key — already merged. This
+    lets the tensor compiler encode the rank as one scalar
+    (spec << 33 | (65535-width) << 16 | port_lo) and resolve precedence with a
+    vectorized max, bit-identical to this ladder (compile/policy_image.py).
+    """
     width = key.port_hi - key.port_lo
-    return (key.specificity(), -width, key.identity, key.proto, key.port_lo)
+    return (key.specificity(), -width, key.port_lo, key.identity, key.proto)
+
+
+def rank_scalar(key: MapStateKey) -> int:
+    """The scalar encoding of :func:`_rank` used by the tensor compiler."""
+    width = key.port_hi - key.port_lo
+    return (key.specificity() << 33) | ((65535 - width) << 16) | key.port_lo

@@ -74,24 +74,28 @@ PORT_PROTOS = (PROTO_TCP, PROTO_UDP, PROTO_SCTP)
 
 # Dense "proto family" index used by the compiled tensors: ports only make
 # sense for TCP/UDP/SCTP; ICMP type is carried in the port field (upstream CT
-# does the same trick with ICMP type/code in the port slots).
+# does the same trick with ICMP type/code in the port slots). ICMP and ICMPv6
+# are distinct families so their entries never shadow each other's cells.
 PROTO_FAMILY_TCP = 0
 PROTO_FAMILY_UDP = 1
 PROTO_FAMILY_SCTP = 2
-PROTO_FAMILY_ICMP = 3   # ICMP and ICMPv6
-PROTO_FAMILY_OTHER = 4
-N_PROTO_FAMILIES = 5
+PROTO_FAMILY_ICMP = 3
+PROTO_FAMILY_ICMP6 = 4
+PROTO_FAMILY_OTHER = 5
+N_PROTO_FAMILIES = 6
 
 
-def proto_family(proto: int, is_ipv6: bool = False) -> int:
+def proto_family(proto: int) -> int:
     if proto == PROTO_TCP:
         return PROTO_FAMILY_TCP
     if proto == PROTO_UDP:
         return PROTO_FAMILY_UDP
     if proto == PROTO_SCTP:
         return PROTO_FAMILY_SCTP
-    if proto in (PROTO_ICMP, PROTO_ICMP6):
+    if proto == PROTO_ICMP:
         return PROTO_FAMILY_ICMP
+    if proto == PROTO_ICMP6:
+        return PROTO_FAMILY_ICMP6
     return PROTO_FAMILY_OTHER
 
 

@@ -1,0 +1,181 @@
+"""Kernel unit tests on the CPU backend: hash np/jnp agreement, LPM walk vs
+host reference, L7 match vs host reference, CT probe/insert mechanics."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.l7 import L7SetInterner, build_l7_tensors, l7_match_host
+from cilium_tpu.compile.lpm import build_lpm, lpm_lookup_host
+from cilium_tpu.kernels import conntrack as ctk
+from cilium_tpu.kernels.hashing import hash_words_jnp, hash_words_np
+from cilium_tpu.kernels.l7 import l7_match_batch
+from cilium_tpu.kernels.lpm import lpm_lookup_batch
+from cilium_tpu.kernels.records import ct_key_words, empty_batch
+from cilium_tpu.model.rules import HTTPRule
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+
+
+class TestHash:
+    def test_np_jnp_agree(self):
+        rng = np.random.default_rng(0)
+        words = rng.integers(0, 2**32, size=(64, 10), dtype=np.uint32)
+        h_np = hash_words_np(words)
+        h_jnp = np.asarray(hash_words_jnp(jnp.asarray(words)))
+        np.testing.assert_array_equal(h_np, h_jnp)
+
+    def test_avalanche(self):
+        words = np.zeros((2, 10), dtype=np.uint32)
+        words[1, 9] = 1
+        h = hash_words_np(words)
+        assert h[0] != h[1]
+
+
+class TestLPMKernel:
+    def test_matches_host_walk(self):
+        entries = {"10.0.0.0/8": 100, "10.1.0.0/16": 200, "10.1.2.3/32": 300,
+                   "2001:db8::/32": 400, "::/0": 500, "0.0.0.0/0": 600}
+        ids = sorted(set(entries.values()) | {C.IDENTITY_WORLD})
+        index = {v: i for i, v in enumerate(ids)}
+        tables = build_lpm(entries, index, default_index=index[C.IDENTITY_WORLD])
+        probes = ["10.1.2.3", "10.1.9.9", "10.2.3.4", "9.9.9.9",
+                  "2001:db8::1", "fe80::1"]
+        addr_words = np.zeros((len(probes), 4), dtype=np.uint32)
+        is_v6 = np.zeros(len(probes), dtype=bool)
+        want = []
+        for i, a in enumerate(probes):
+            a16, v6 = parse_addr(a)
+            addr_words[i] = np.frombuffer(a16, dtype=">u4")
+            is_v6[i] = v6
+            want.append(lpm_lookup_host(tables, a16, v6))
+        got = np.asarray(lpm_lookup_batch(
+            jnp.asarray(tables.v4_nodes), jnp.asarray(tables.v6_nodes),
+            jnp.asarray(addr_words), jnp.asarray(is_v6),
+            default_index=index[C.IDENTITY_WORLD]))
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+
+class TestL7Kernel:
+    def test_matches_host(self):
+        interner = L7SetInterner()
+        s1 = interner.intern(frozenset({HTTPRule("GET", "/api"),
+                                        HTTPRule("", "/pub")}))
+        s2 = interner.intern(frozenset({HTTPRule("POST", "/x")}))
+        t = build_l7_tensors(interner)
+        cases = [(s1, 0, b"/api/v1"), (s1, 1, b"/api"), (s1, 1, b"/pub/z"),
+                 (s2, 1, b"/x"), (s2, 0, b"/x"), (0, 0, b"/whatever"),
+                 (s1, 0, b""), (s2, 1, b"")]
+        n = len(cases)
+        set_id = jnp.asarray([c[0] for c in cases], dtype=jnp.int32)
+        method = jnp.asarray([c[1] for c in cases], dtype=jnp.int32)
+        path = np.zeros((n, C.L7_PATH_MAXLEN), dtype=np.uint8)
+        for i, (_, _, p) in enumerate(cases):
+            path[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+        tensors = {"l7_methods": jnp.asarray(t.methods),
+                   "l7_valid": jnp.asarray(t.valid),
+                   "l7_path_len": jnp.asarray(t.path_len),
+                   "l7_path": jnp.asarray(t.path)}
+        got = np.asarray(l7_match_batch(tensors, set_id, method,
+                                        jnp.asarray(path)))
+        want = [l7_match_host(t, sid, m, p) if sid > 0 else True
+                for sid, m, p in cases]
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def _mk_batch(n, tuples):
+    """tuples: list of (src, dst, sport, dport, proto, dir)."""
+    b = empty_batch(n)
+    for i, (src, dst, sp, dp, proto, d) in enumerate(tuples):
+        s16, sv6 = parse_addr(src)
+        d16, dv6 = parse_addr(dst)
+        b["src"][i] = np.frombuffer(s16, dtype=">u4")
+        b["dst"][i] = np.frombuffer(d16, dtype=">u4")
+        b["sport"][i], b["dport"][i] = sp, dp
+        b["proto"][i] = proto
+        b["direction"][i] = d
+        b["is_v6"][i] = sv6
+        b["valid"][i] = True
+    return b
+
+
+class TestCTKernel:
+    def _jnp_ct(self, cap=1024):
+        return {k: jnp.asarray(v) for k, v in
+                make_ct_arrays(CTConfig(capacity=cap)).items()}
+
+    def test_probe_miss_on_empty(self):
+        ct = self._jnp_ct()
+        b = _mk_batch(4, [("10.0.0.1", "10.0.0.2", 1, 2, 6, 0)] * 4)
+        keys = ctk.ct_key_words_jnp({k: jnp.asarray(v) for k, v in b.items()})
+        slot = ctk.ct_probe(ct, keys, jnp.uint32(100))
+        assert (np.asarray(slot) == -1).all()
+
+    def test_insert_then_probe_hits(self):
+        ct = self._jnp_ct()
+        b = {k: jnp.asarray(v) for k, v in _mk_batch(
+            4, [("10.0.0.1", "10.0.0.2", 1000 + i, 80, 6, 0)
+                for i in range(4)]).items()}
+        keys = ctk.ct_key_words_jnp(b)
+        want = jnp.asarray([True] * 4)
+        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, want, jnp.zeros(4, jnp.int32), jnp.uint32(100))
+        assert (np.asarray(slot) >= 0).all() and not np.asarray(fail).any()
+        ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(4, bool), want,
+                           jnp.uint32(100), new_keys=nk, new_l7=nl7,
+                           new_created=ncr, zero_mask=zm)
+        slot2 = ctk.ct_probe(ct2, keys, jnp.uint32(101))
+        np.testing.assert_array_equal(np.asarray(slot2), np.asarray(slot))
+
+    def test_duplicate_keys_one_slot(self):
+        ct = self._jnp_ct()
+        b = {k: jnp.asarray(v) for k, v in _mk_batch(
+            4, [("10.0.0.1", "10.0.0.2", 7, 80, 6, 0)] * 4).items()}
+        keys = ctk.ct_key_words_jnp(b)
+        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, jnp.asarray([True] * 4), jnp.zeros(4, jnp.int32),
+            jnp.uint32(100))
+        s = np.asarray(slot)
+        assert (s == s[0]).all() and (s >= 0).all()
+        assert int(np.asarray(zm).sum()) == 1  # exactly one slot claimed
+
+    def test_insert_fail_when_window_full(self):
+        # capacity 8 with probe depth 8: 9 distinct keys that all hash into a
+        # full table → at least one fail
+        ct = self._jnp_ct(cap=8)
+        tuples = [("10.0.0.1", "10.0.0.2", 100 + i, 80, 6, 0) for i in range(12)]
+        b = {k: jnp.asarray(v) for k, v in _mk_batch(12, tuples).items()}
+        keys = ctk.ct_key_words_jnp(b)
+        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, jnp.asarray([True] * 12), jnp.zeros(12, jnp.int32),
+            jnp.uint32(100))
+        assert int(np.asarray(fail).sum()) >= 4  # 8 slots, 12 flows
+        assert int(np.asarray(zm).sum()) == 8
+
+    def test_sweep_reclaims(self):
+        ct = self._jnp_ct()
+        raw = _mk_batch(1, [("10.0.0.1", "10.0.0.2", 7, 80, 6, 0)])
+        raw["tcp_flags"][0] = C.TCP_SYN  # SYN-only → 60s lifetime
+        b = {k: jnp.asarray(v) for k, v in raw.items()}
+        keys = ctk.ct_key_words_jnp(b)
+        one = jnp.asarray([True])
+        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, one, jnp.zeros(1, jnp.int32), jnp.uint32(100))
+        ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(1, bool), one,
+                           jnp.uint32(100), new_keys=nk, new_l7=nl7,
+                           new_created=ncr, zero_mask=zm)
+        ct3, n = ctk.ct_sweep(ct2, jnp.uint32(100 + C.CT_LIFETIME_SYN + 1))
+        assert int(n) == 1
+        assert ctk.ct_probe(ct3, keys, jnp.uint32(200))[0] == -1
+
+    def test_key_words_np_jnp_agree(self):
+        b = _mk_batch(3, [("10.0.0.1", "10.0.0.2", 5, 6, 17, 1),
+                          ("2001:db8::1", "2001:db8::2", 9, 10, 6, 0),
+                          ("1.1.1.1", "2.2.2.2", 0, 0, 1, 0)])
+        for rev in (False, True):
+            np_words = ct_key_words(b, reverse=rev)
+            jnp_words = np.asarray(ctk.ct_key_words_jnp(
+                {k: jnp.asarray(v) for k, v in b.items()}, reverse=rev))
+            np.testing.assert_array_equal(np_words, jnp_words)
