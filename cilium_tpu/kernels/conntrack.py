@@ -16,31 +16,16 @@ tracking fails open, policy never does.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
-
-import jax
 import jax.numpy as jnp
 
-from cilium_tpu.compile.ct_layout import KEY_WORDS, PROBE_DEPTH
+from cilium_tpu.compile.ct_layout import PROBE_DEPTH
 from cilium_tpu.kernels.hashing import hash_words_jnp
+from cilium_tpu.kernels.records import ct_key_words_generic
 from cilium_tpu.utils import constants as C
 
 
 def ct_key_words_jnp(batch, reverse: bool = False):
-    """jnp mirror of kernels.records.ct_key_words."""
-    src, dst = ((batch["dst"], batch["src"]) if reverse
-                else (batch["src"], batch["dst"]))
-    sport, dport = ((batch["dport"], batch["sport"]) if reverse
-                    else (batch["sport"], batch["dport"]))
-    direction = ((1 - batch["direction"]) if reverse else batch["direction"])
-    words = [
-        src[:, 0], src[:, 1], src[:, 2], src[:, 3],
-        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
-        (sport.astype(jnp.uint32) << jnp.uint32(16)) | dport.astype(jnp.uint32),
-        (batch["proto"].astype(jnp.uint32) << jnp.uint32(8))
-        | direction.astype(jnp.uint32),
-    ]
-    return jnp.stack(words, axis=-1)
+    return ct_key_words_generic(jnp, batch, reverse)
 
 
 def ct_probe(ct, keys, now, probe_depth: int = PROBE_DEPTH):
@@ -82,11 +67,11 @@ def _lifetime(proto, flags):
     return jnp.where(is_tcp, tcp_life, C.CT_LIFETIME_NONTCP).astype(jnp.uint32)
 
 
-def ct_insert_new(ct, keys, want_insert, l7_id, now,
+def ct_insert_new(ct, keys, want_insert, now,
                   probe_depth: int = PROBE_DEPTH):
     """Deterministic parallel insert of new flows.
 
-    Returns (new_keys, new_l7, new_created, zero_mask, slot_of, fail):
+    Returns (new_keys, new_created, zero_mask, slot_of, fail):
     - ``zero_mask`` [cap] marks freshly-claimed slots whose value arrays
       (flags/counters) must be reset before aggregation;
     - ``slot_of`` [N] is the entry slot for every packet whose flow now has
@@ -100,7 +85,6 @@ def ct_insert_new(ct, keys, want_insert, l7_id, now,
     base = (hash_words_jnp(keys) & jnp.uint32(mask)).astype(jnp.int32)
 
     keys_arr = ct["keys"]
-    l7_arr = ct["l7_id"]
     created_arr = ct["created"]
     claimed = jnp.zeros((cap,), dtype=bool)
     zero_mask = jnp.zeros((cap,), dtype=bool)
@@ -125,7 +109,6 @@ def ct_insert_new(ct, keys, want_insert, l7_id, now,
         winner = attempt & (claim[s] == idx)
         ws = jnp.where(winner, s, cap)
         keys_arr = keys_arr.at[ws].set(keys, mode="drop")
-        l7_arr = l7_arr.at[ws].set(l7_id.astype(jnp.uint32), mode="drop")
         created_arr = created_arr.at[ws].set(now, mode="drop")
         claimed = claimed.at[ws].set(True, mode="drop")
         zero_mask = zero_mask.at[ws].set(True, mode="drop")
@@ -141,11 +124,11 @@ def ct_insert_new(ct, keys, want_insert, l7_id, now,
         slot_of = jnp.where(adopted, s, slot_of)
         pending = pending & ~adopted
 
-    return keys_arr, l7_arr, created_arr, zero_mask, slot_of, pending
+    return keys_arr, created_arr, zero_mask, slot_of, pending
 
 
 def ct_apply(ct, batch, slot, is_reply, contrib, now,
-             new_keys=None, new_l7=None, new_created=None, zero_mask=None):
+             new_keys=None, new_created=None, zero_mask=None):
     """Aggregate all allowed packets' effects into the table (snapshot
     semantics). ``slot`` [N] (-1 = none), ``contrib`` [N] bool.
 
@@ -153,7 +136,6 @@ def ct_apply(ct, batch, slot, is_reply, contrib, now,
     """
     cap = ct["expiry"].shape[0]
     keys_arr = new_keys if new_keys is not None else ct["keys"]
-    l7_arr = new_l7 if new_l7 is not None else ct["l7_id"]
     created_arr = new_created if new_created is not None else ct["created"]
     flags = ct["flags"]
     fwd = ct["pkts_fwd"]
@@ -190,7 +172,6 @@ def ct_apply(ct, batch, slot, is_reply, contrib, now,
         "expiry": expiry,
         "created": created_arr,
         "flags": flags,
-        "l7_id": l7_arr,
         "pkts_fwd": fwd,
         "pkts_rev": rev,
     }
@@ -205,7 +186,6 @@ def ct_sweep(ct, now):
     new_ct["expiry"] = jnp.where(dead, zero32, ct["expiry"])
     new_ct["keys"] = jnp.where(dead[:, None], zero32, ct["keys"])
     new_ct["flags"] = jnp.where(dead, zero32, ct["flags"])
-    new_ct["l7_id"] = jnp.where(dead, zero32, ct["l7_id"])
     new_ct["pkts_fwd"] = jnp.where(dead, zero32, ct["pkts_fwd"])
     new_ct["pkts_rev"] = jnp.where(dead, zero32, ct["pkts_rev"])
     new_ct["created"] = jnp.where(dead, zero32, ct["created"])

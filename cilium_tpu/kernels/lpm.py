@@ -20,7 +20,9 @@ def _walk(nodes, addr_words, byte_index, levels, default_index):
     dead = n_nodes - 1
     n = addr_words.shape[0]
     node = jnp.zeros((n,), dtype=jnp.int32)
-    best = jnp.full((n,), default_index, dtype=jnp.int32)
+    # default_index may be a traced scalar (snapshot-dependent) — broadcast,
+    # don't bake
+    best = jnp.broadcast_to(jnp.asarray(default_index, jnp.int32), (n,))
     for level in range(levels):
         pos = byte_index(level)
         word = addr_words[:, pos // 4]

@@ -9,12 +9,33 @@ import jax.numpy as jnp
 from cilium_tpu.utils import constants as C
 
 
-def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport):
-    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool)."""
+def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
+                        rule_axis=None):
+    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool).
+
+    ``rule_axis``: name of a mesh axis over which the verdict tensor's
+    id-class rows are sharded (the "tensor parallelism over rule space" of
+    SURVEY.md §2's parallelism table). Each shard gathers rows it owns and a
+    psum combines — one XLA collective, no gather of remote rows. Rows must
+    be padded to a multiple of the axis size (compile/parallel handles it).
+    """
     id_cls = tensors["id_class_of"][id_index]
     fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
     pcls = tensors["port_class"][fam, jnp.clip(dport, 0, 65535)]
-    cell = tensors["verdict"][ep_slot, direction, id_cls, pcls].astype(jnp.int32)
+    if rule_axis is None:
+        cell = tensors["verdict"][ep_slot, direction, id_cls, pcls].astype(jnp.int32)
+    else:
+        import jax
+        rows_local = tensors["verdict"].shape[2]
+        ri = jax.lax.axis_index(rule_axis)
+        local_idx = id_cls - ri * rows_local
+        in_range = (local_idx >= 0) & (local_idx < rows_local)
+        safe = jnp.clip(local_idx, 0, rows_local - 1)
+        cell_local = jnp.where(
+            in_range,
+            tensors["verdict"][ep_slot, direction, safe, pcls].astype(jnp.int32),
+            0)
+        cell = jax.lax.psum(cell_local, rule_axis)
     enforced = tensors["enforced"][ep_slot, direction]
     decision = cell & C.VERDICT_DECISION_MASK
     l7_id = cell >> C.VERDICT_L7_SHIFT

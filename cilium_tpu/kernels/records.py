@@ -39,7 +39,12 @@ def _addr_words(addr16: bytes) -> np.ndarray:
 
 def batch_from_records(records: Sequence, ep_slot_of: Dict[int, int],
                        pad_to: int = 0) -> BatchArrays:
-    """Build a batch from oracle PacketRecords (tests / pcap replay)."""
+    """Build a batch from oracle PacketRecords (tests / pcap replay).
+
+    Records for endpoints unknown to the snapshot fail closed: they are left
+    ``valid=False`` (never forwarded, no CT effects) — the batch-level analog
+    of the oracle's INVALID_IDENTITY drop for unknown endpoints.
+    """
     n = max(len(records), pad_to)
     b = empty_batch(n)
     for i, p in enumerate(records):
@@ -50,7 +55,10 @@ def batch_from_records(records: Sequence, ep_slot_of: Dict[int, int],
         b["proto"][i] = p.proto
         b["tcp_flags"][i] = p.tcp_flags
         b["is_v6"][i] = p.is_ipv6
-        b["ep_slot"][i] = ep_slot_of[p.ep_id]
+        slot = ep_slot_of.get(p.ep_id)
+        if slot is None:
+            continue  # fail closed: stays invalid
+        b["ep_slot"][i] = slot
         b["direction"][i] = p.direction
         b["http_method"][i] = p.http_method
         pb = p.http_path[:C.L7_PATH_MAXLEN]
@@ -60,17 +68,25 @@ def batch_from_records(records: Sequence, ep_slot_of: Dict[int, int],
     return b
 
 
-def ct_key_words(batch: BatchArrays, reverse: bool = False) -> np.ndarray:
+def ct_key_words_generic(xp, batch: Dict, reverse: bool = False):
     """[N, 10] uint32 conntrack key (see compile/ct_layout.py), forward or
-    reverse orientation. numpy version; kernels/conntrack.py mirrors in jnp."""
-    src, dst = (batch["dst"], batch["src"]) if reverse else (batch["src"], batch["dst"])
+    reverse orientation. One definition, two executors (xp = np on host,
+    jnp on device) so the key layout cannot silently diverge between the
+    device table and host checkpoint/export."""
+    src, dst = ((batch["dst"], batch["src"]) if reverse
+                else (batch["src"], batch["dst"]))
     sport, dport = ((batch["dport"], batch["sport"]) if reverse
                     else (batch["sport"], batch["dport"]))
     direction = (1 - batch["direction"]) if reverse else batch["direction"]
-    n = src.shape[0]
-    words = np.zeros((n, 10), dtype=np.uint32)
-    words[:, 0:4] = src
-    words[:, 4:8] = dst
-    words[:, 8] = (sport.astype(np.uint32) << 16) | dport.astype(np.uint32)
-    words[:, 9] = (batch["proto"].astype(np.uint32) << 8) | direction.astype(np.uint32)
-    return words
+    words = [
+        src[:, 0], src[:, 1], src[:, 2], src[:, 3],
+        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
+        (sport.astype(xp.uint32) << xp.uint32(16)) | dport.astype(xp.uint32),
+        (batch["proto"].astype(xp.uint32) << xp.uint32(8))
+        | direction.astype(xp.uint32),
+    ]
+    return xp.stack(words, axis=-1)
+
+
+def ct_key_words(batch: BatchArrays, reverse: bool = False) -> np.ndarray:
+    return ct_key_words_generic(np, batch, reverse)

@@ -160,6 +160,8 @@ class Rule:
     # exactly like upstream (a CNP with `ingress: []` default-denies ingress).
     has_ingress_section: bool = False
     has_egress_section: bool = False
+    # The source JSON document (checkpoint/resume re-serializes from this).
+    raw: Optional[Dict] = None
 
     def selects(self, ep_labels: Labels) -> bool:
         return self.endpoint_selector.matches(ep_labels)
@@ -303,6 +305,7 @@ def parse_rule(obj: Dict) -> Rule:
         description=obj.get("description", ""),
         has_ingress_section=("ingress" in obj or "ingressDeny" in obj),
         has_egress_section=("egress" in obj or "egressDeny" in obj),
+        raw=obj,
     )
 
 

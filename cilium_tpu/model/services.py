@@ -57,3 +57,8 @@ class ServiceRegistry:
         with self._lock:
             return [svc for svc in self._services.values()
                     if selector.matches(svc.labels)]
+
+    def all(self) -> List[Service]:
+        with self._lock:
+            return sorted(self._services.values(),
+                          key=lambda s: (s.namespace, s.name))

@@ -1,0 +1,23 @@
+"""Multi-chip scale-out (SURVEY.md §2 parallelism inventory, re-expressed
+TPU-natively):
+
+- **flow axis** (data parallelism, the per-CPU-map/RSS analog): batches shard
+  across chips; each chip owns an independent conntrack shard; the host (or
+  shim) steers packets by direction-normalized flow hash so both directions
+  of a flow land on the owning shard — exactly RSS steering. Counters are the
+  only cross-chip traffic (one psum over ICI).
+- **rule axis** ("tensor parallelism over rule space"): when identity-class ×
+  port-class verdict tensors outgrow a chip, rows shard across the axis and a
+  psum combines each packet's cell.
+"""
+
+from cilium_tpu.parallel.mesh import (
+    flow_shard_of, make_mesh, make_sharded_classify_fn, pad_snapshot_tensors,
+    shard_ct_arrays, steer_batch, unsteer_outputs,
+)
+
+__all__ = [
+    "flow_shard_of", "make_mesh", "make_sharded_classify_fn",
+    "pad_snapshot_tensors", "shard_ct_arrays", "steer_batch",
+    "unsteer_outputs",
+]

@@ -1,0 +1,191 @@
+"""Device mesh construction + shard_map'd classify (see package docstring).
+
+Design notes (the scaling-book recipe: pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+- mesh axes: ``('flows', 'rules')`` — flows is the DP axis (batch + CT
+  sharded), rules the rule-space axis (verdict rows sharded). Either may be
+  size 1.
+- inside the shard_map body the ONLY collectives are: one psum per counter
+  (flows axis) and, when rule sharding is on, one psum for the policy cell
+  (rules axis). Everything else is embarrassingly parallel — this is the
+  RSS/per-CPU-map structure of the reference datapath, on ICI.
+- CT sharding: the table's slot axis splits across 'flows'; each local table
+  is an independent power-of-two hash table. Correct flow→shard placement is
+  the HOST's job (steer_batch) — the direction-normalized hash guarantees a
+  flow's forward and reply packets reach the same shard, so device code
+  needs no cross-chip CT traffic at all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.compile.ct_layout import PROBE_DEPTH
+from cilium_tpu.kernels.hashing import hash_words_np
+from cilium_tpu.kernels.records import BatchArrays, ct_key_words
+
+
+def make_mesh(n_flow_shards: int, n_rule_shards: int = 1, devices=None):
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    need = n_flow_shards * n_rule_shards
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_flow_shards, n_rule_shards)
+    return Mesh(arr, ("flows", "rules"))
+
+
+# --------------------------------------------------------------------------- #
+# Host-side steering (the RSS analog; the C++ shim implements the same hash)
+# --------------------------------------------------------------------------- #
+def flow_shard_of(batch: BatchArrays, n_shards: int) -> np.ndarray:
+    """Direction-normalized shard index per packet: XOR of forward and
+    reverse key hashes is symmetric, so both directions of a flow agree."""
+    h = hash_words_np(ct_key_words(batch, reverse=False)) \
+        ^ hash_words_np(ct_key_words(batch, reverse=True))
+    return (h % np.uint32(n_shards)).astype(np.int32)
+
+
+def steer_batch(batch: BatchArrays, n_shards: int,
+                per_shard: Optional[int] = None
+                ) -> Tuple[BatchArrays, np.ndarray, int]:
+    """Regroup a batch so packets of shard s occupy rows
+    [s*per_shard, (s+1)*per_shard) (invalid-padded).
+
+    Returns (steered_batch, scatter_index, per_shard) where
+    ``scatter_index[i]`` is the steered row of original packet i — use it to
+    gather per-packet outputs back into original order."""
+    n = batch["valid"].shape[0]
+    shard = flow_shard_of(batch, n_shards)
+    shard = np.where(np.asarray(batch["valid"]), shard, n_shards - 1)
+    counts = np.bincount(shard, weights=np.asarray(batch["valid"]).astype(np.int64),
+                         minlength=n_shards).astype(np.int64)
+    if per_shard is None:
+        per_shard = int(max(1, counts.max()))
+    out = {k: np.zeros((n_shards * per_shard,) + v.shape[1:], dtype=v.dtype)
+           for k, v in batch.items()}
+    out["http_method"][:] = 255
+    scatter = np.full((n,), -1, dtype=np.int64)
+    fill = np.zeros(n_shards, dtype=np.int64)
+    for i in range(n):
+        if not batch["valid"][i]:
+            continue
+        s = shard[i]
+        if fill[s] >= per_shard:
+            raise ValueError("per_shard too small for steering")
+        row = s * per_shard + fill[s]
+        fill[s] += 1
+        scatter[i] = row
+        for k, v in batch.items():
+            out[k][row] = v[i]
+    return out, scatter, per_shard
+
+
+def unsteer_outputs(out: Dict[str, np.ndarray],
+                    scatter: np.ndarray) -> Dict[str, np.ndarray]:
+    """Map steered per-packet outputs back to original packet order.
+    Packets that were invalid get zeros."""
+    n = scatter.shape[0]
+    result = {}
+    safe = np.where(scatter >= 0, scatter, 0)
+    for k, v in out.items():
+        gathered = np.asarray(v)[safe]
+        gathered[scatter < 0] = 0
+        result[k] = gathered
+    return result
+
+
+# --------------------------------------------------------------------------- #
+# Array preparation for the mesh
+# --------------------------------------------------------------------------- #
+def pad_snapshot_tensors(tensors: Dict[str, np.ndarray],
+                         n_rule_shards: int) -> Dict[str, np.ndarray]:
+    """Pad verdict id-class rows to a multiple of the rules axis. Padded rows
+    are all-MISS (never gathered: id_class_of never points at them)."""
+    if n_rule_shards <= 1:
+        return tensors
+    v = tensors["verdict"]
+    rows = v.shape[2]
+    padded = -(-rows // n_rule_shards) * n_rule_shards
+    if padded != rows:
+        pad = np.zeros((v.shape[0], v.shape[1], padded - rows, v.shape[3]),
+                       dtype=v.dtype)
+        tensors = dict(tensors)
+        tensors["verdict"] = np.concatenate([v, pad], axis=2)
+    return tensors
+
+
+def shard_ct_arrays(ct: Dict[str, np.ndarray],
+                    n_flow_shards: int) -> Dict[str, np.ndarray]:
+    """Validate the CT capacity divides into power-of-two local tables."""
+    cap = ct["expiry"].shape[0]
+    local = cap // n_flow_shards
+    if local * n_flow_shards != cap or (local & (local - 1)):
+        raise ValueError(
+            f"CT capacity {cap} must split into {n_flow_shards} "
+            f"power-of-two shards")
+    return ct
+
+
+# --------------------------------------------------------------------------- #
+# The meshed classify step
+# --------------------------------------------------------------------------- #
+def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
+                             v4_only: bool = False, donate_ct: bool = True):
+    """shard_map'd + jitted classify step over ``mesh`` ('flows','rules').
+
+    Call with (tensors, ct, batch, now, world_index) where batch rows are
+    steered (steer_batch) and verdict rows padded (pad_snapshot_tensors).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from cilium_tpu.kernels.classify import classify_step
+
+    rule_sharded = mesh.shape["rules"] > 1
+    rule_axis = "rules" if rule_sharded else None
+
+    def local_fn(tensors, ct, batch, now, world_index):
+        out, new_ct, counters = classify_step(
+            tensors, ct, batch, now, world_index,
+            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis)
+        # counters are global: reduce over 'flows' only — along 'rules' the
+        # batch is replicated and every shard computes identical counts
+        # (summing there would multiply by the rules-axis size)
+        counters = {
+            "by_reason_dir": jax.lax.psum(counters["by_reason_dir"], "flows"),
+            "insert_fail": jax.lax.psum(counters["insert_fail"], "flows"),
+        }
+        return out, new_ct, counters
+
+    verdict_spec = P(None, None, "rules", None) if rule_sharded else P()
+    tensors_spec = {
+        "verdict": verdict_spec,
+        "enforced": P(), "id_class_of": P(), "identity_ids": P(),
+        "lpm_v4": P(), "lpm_v6": P(), "port_class": P(), "proto_family": P(),
+        "l7_methods": P(), "l7_path": P(), "l7_path_len": P(), "l7_valid": P(),
+    }
+    ct_spec = {k: P("flows") for k in
+               ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev")}
+    batch_spec = {k: P("flows") for k in
+                  ("src", "dst", "sport", "dport", "proto", "tcp_flags",
+                   "is_v6", "ep_slot", "direction", "http_method",
+                   "http_path", "valid")}
+    out_spec = {k: P("flows") for k in
+                ("allow", "reason", "status", "remote_identity", "redirect")}
+    counters_spec = {"by_reason_dir": P(), "insert_fail": P()}
+
+    fn = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(tensors_spec, ct_spec, batch_spec, P(), P()),
+        out_specs=(out_spec, ct_spec, counters_spec),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

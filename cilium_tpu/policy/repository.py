@@ -119,9 +119,14 @@ class Repository:
 
     def replace_by_labels(self, match: Labels, rules: Sequence[Rule]) -> int:
         """Replace all rules carrying every label in ``match`` (the CNP
-        update path — upstream ReplaceByLabels)."""
+        update path — upstream ReplaceByLabels). An empty match set would
+        silently delete every rule, so it is rejected; use ``clear()``."""
         with self._lock:
             want = set(match.to_strings())
+            if not want:
+                raise ValueError(
+                    "replace_by_labels with empty labels would match every "
+                    "rule; use clear() to drop all rules")
             kept: List[Rule] = []
             for r in self._rules:
                 if want.issubset(set(r.labels.to_strings())):
@@ -136,6 +141,14 @@ class Repository:
 
     def delete_by_labels(self, match: Labels) -> int:
         return self.replace_by_labels(match, [])
+
+    def clear(self) -> int:
+        """Remove every rule (releasing owned resources)."""
+        with self._lock:
+            for rule in self._rules:
+                self._release(self._resources.pop(id(rule)))
+            self._rules = []
+            return self._bump()
 
     def all_rules(self) -> List[Rule]:
         with self._lock:

@@ -1,0 +1,9 @@
+"""Host runtime: engine, config, controllers, checkpoint, metrics, flow log
+(analogs of upstream ``daemon/``, ``pkg/option``, ``pkg/controller`` /
+``pkg/trigger``, endpoint-state checkpointing, ``pkg/metrics``, Hubble-lite).
+"""
+
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.engine import Engine
+
+__all__ = ["DaemonConfig", "Engine"]

@@ -1,0 +1,97 @@
+"""Checkpoint/resume (SURVEY.md §5: the analog of upstream's endpoint state
+dir + pinned BPF maps — "connection survival across upgrades is a headline
+feature").
+
+A checkpoint is a directory:
+  state.json   — config echo, revision, identity allocator state, ipcache
+                 entries, endpoints, rule documents, services
+  ct.npz       — the conntrack arrays (the pinned-ctmap analog: flows
+                 survive an agent restart)
+
+Resume rebuilds the engine's host state (identity numbering stable via the
+allocator export), re-materializes rules, recompiles the snapshot, and
+re-places the CT table — device arrays are a cache of host truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+from cilium_tpu.model.services import Service
+from cilium_tpu.runtime.engine import Engine
+
+STATE_FILE = "state.json"
+CT_FILE = "ct.npz"
+FORMAT_VERSION = 1
+
+
+def save(engine: Engine, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    state = {
+        "format_version": FORMAT_VERSION,
+        "revision": engine.repo.revision,
+        "identity_state": engine.ctx.allocator.export_state(),
+        "ipcache": engine.ctx.ipcache.snapshot(),
+        "endpoints": [
+            {"ep_id": ep.ep_id, "labels": list(ep.labels.to_strings()),
+             "ips": list(ep.ips), "enforcement": ep.enforcement}
+            for ep in sorted(engine.endpoints.values(), key=lambda e: e.ep_id)
+        ],
+        "rules": [r.raw for r in engine.repo.all_rules() if r.raw is not None],
+        "services": [
+            {"name": s.name, "namespace": s.namespace,
+             "backends": list(s.backends)}
+            for s in engine.ctx.services.all()
+        ],
+    }
+    # write-then-rename so a crash never leaves a torn checkpoint
+    fd, tmp = tempfile.mkstemp(dir=path, prefix=".state-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, os.path.join(path, STATE_FILE))
+
+    ct = engine.ct_arrays()
+    fd, tmp = tempfile.mkstemp(dir=path, prefix=".ct-", suffix=".npz")
+    with os.fdopen(fd, "wb") as f:
+        np.savez_compressed(f, **ct)
+    os.replace(tmp, os.path.join(path, CT_FILE))
+
+
+def restore(engine: Engine, path: str) -> None:
+    """Restore host + CT state into a FRESH engine (no endpoints/rules yet)."""
+    with open(os.path.join(path, STATE_FILE)) as f:
+        state = json.load(f)
+    if state.get("format_version") != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version "
+                         f"{state.get('format_version')}")
+    if engine.endpoints or len(engine.repo):
+        raise ValueError("restore requires a fresh engine")
+
+    # identity numbering must be restored FIRST so that endpoint/CIDR
+    # allocation below resolves to the same ids (idempotent via label lookup)
+    engine.ctx.allocator.restore_state(state["identity_state"])
+    for svc in state.get("services", []):
+        engine.ctx.services.upsert(Service(
+            name=svc["name"], namespace=svc["namespace"],
+            backends=tuple(svc["backends"])))
+    for ep in state["endpoints"]:
+        engine.add_endpoint(ep["labels"], ep["ips"], ep_id=ep["ep_id"],
+                            enforcement=ep.get("enforcement"))
+    if state["rules"]:
+        engine.apply_policy(state["rules"])
+    # ipcache entries not re-derivable (e.g. manual upserts) are replayed
+    current = engine.ctx.ipcache.snapshot()
+    for prefix, ident in state["ipcache"].items():
+        if prefix not in current:
+            engine.ctx.ipcache.upsert(prefix, ident)
+
+    ct_path = os.path.join(path, CT_FILE)
+    if os.path.exists(ct_path):
+        with np.load(ct_path) as npz:
+            engine.load_ct_arrays({k: npz[k] for k in npz.files})
+    engine.regenerate(force=True)

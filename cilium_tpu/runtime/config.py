@@ -1,0 +1,93 @@
+"""Daemon configuration (analog of upstream ``pkg/option.DaemonConfig`` +
+``pkg/defaults`` — one frozen dataclass, sourced file < env < CLI flags,
+with the runtime-mutable subset limited to what upstream allows at runtime
+(policy enforcement mode)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from cilium_tpu.utils import constants as C
+
+ENV_PREFIX = "CILIUM_TPU_"
+
+
+@dataclass
+class DaemonConfig:
+    # --- policy semantics (part of the parity contract) ---
+    enforcement_mode: str = C.ENFORCEMENT_DEFAULT
+    allow_localhost: bool = True
+    # --- datapath geometry ---
+    ct_capacity: int = 1 << 20
+    probe_depth: int = 8
+    batch_size: int = 8192
+    v4_only: bool = False
+    # --- device/runtime ---
+    device: str = "auto"           # auto | cpu | tpu
+    n_shards: int = 1              # data-parallel flow shards (mesh size)
+    rule_shards: int = 1           # rule-space (verdict-row) shards
+    donate_ct: bool = True
+    # --- lifecycle ---
+    state_dir: str = "/var/run/cilium-tpu"
+    sweep_interval_s: float = 30.0
+    regen_debounce_s: float = 0.1
+    auto_regen: bool = True
+    # --- observability ---
+    flowlog_capacity: int = 16384
+    flowlog_mode: str = "drops"    # all | drops | none
+
+    def __post_init__(self):
+        if self.enforcement_mode not in C.ENFORCEMENT_MODES:
+            raise ValueError(f"bad enforcement mode {self.enforcement_mode!r}")
+        if self.ct_capacity & (self.ct_capacity - 1):
+            raise ValueError("ct_capacity must be a power of two")
+        if self.flowlog_mode not in ("all", "drops", "none"):
+            raise ValueError(f"bad flowlog mode {self.flowlog_mode!r}")
+
+    # -- sources -------------------------------------------------------------
+    @classmethod
+    def load(cls, config_file: Optional[str] = None,
+             env: Optional[Dict[str, str]] = None,
+             argv: Optional[list] = None) -> "DaemonConfig":
+        """file < env < flags, like upstream's viper layering."""
+        values: Dict = {}
+        if config_file:
+            with open(config_file) as f:
+                values.update(json.load(f))
+        env = os.environ if env is None else env
+        for f_ in dataclasses.fields(cls):
+            key = ENV_PREFIX + f_.name.upper()
+            if key in env:
+                values[f_.name] = _coerce(f_.type, env[key])
+        if argv is not None:
+            parser = argparse.ArgumentParser(prog="cilium-tpu-agent")
+            for f_ in dataclasses.fields(cls):
+                parser.add_argument(f"--{f_.name.replace('_', '-')}",
+                                    dest=f_.name, default=None)
+            ns = parser.parse_args(argv)
+            for f_ in dataclasses.fields(cls):
+                v = getattr(ns, f_.name)
+                if v is not None:
+                    values[f_.name] = _coerce(f_.type, v)
+        known = {f_.name for f_ in dataclasses.fields(cls)}
+        unknown = set(values) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**values)
+
+
+def _coerce(typ, raw):
+    if isinstance(raw, str):
+        t = str(typ)
+        if "bool" in t:
+            return raw.lower() in ("1", "true", "yes", "on")
+        if "int" in t:
+            return int(raw)
+        if "float" in t:
+            return float(raw)
+    return raw

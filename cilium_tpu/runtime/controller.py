@@ -1,0 +1,147 @@
+"""Controllers and triggers (analog of upstream ``pkg/controller`` named
+retry-loops with exponential backoff and ``pkg/trigger`` debounced triggers —
+SURVEY.md §2: "Port pattern — drives incremental tensor updates").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class ControllerStatus:
+    name: str
+    success_count: int = 0
+    failure_count: int = 0
+    consecutive_failures: int = 0
+    last_error: str = ""
+    last_success: float = 0.0
+
+
+class Controller:
+    """A named reconciliation loop: runs ``do_func`` every ``interval``
+    seconds, retrying with exponential backoff on failure."""
+
+    def __init__(self, name: str, do_func: Callable[[], None],
+                 interval: float, backoff_base: float = 1.0,
+                 backoff_max: float = 60.0):
+        self.status = ControllerStatus(name)
+        self._do = do_func
+        self._interval = interval
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ctrl-{self.status.name}")
+        self._thread.start()
+
+    def trigger(self) -> None:
+        """Run now (out of schedule)."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def run_once(self) -> None:
+        """Synchronous single run (tests / manual mode)."""
+        try:
+            self._do()
+            self.status.success_count += 1
+            self.status.consecutive_failures = 0
+            self.status.last_error = ""
+            self.status.last_success = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — controllers isolate failures
+            self.status.failure_count += 1
+            self.status.consecutive_failures += 1
+            self.status.last_error = f"{type(e).__name__}: {e}"
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+            if self.status.consecutive_failures:
+                delay = min(self._backoff_max,
+                            self._backoff_base
+                            * (2 ** (self.status.consecutive_failures - 1)))
+            else:
+                delay = self._interval
+            self._wake.wait(timeout=delay)
+            self._wake.clear()
+
+
+class ControllerManager:
+    def __init__(self):
+        self._controllers: Dict[str, Controller] = {}
+
+    def update(self, name: str, do_func: Callable[[], None],
+               interval: float, start: bool = True, **kw) -> Controller:
+        old = self._controllers.pop(name, None)
+        if old:
+            old.stop()
+        ctrl = Controller(name, do_func, interval, **kw)
+        self._controllers[name] = ctrl
+        if start:
+            ctrl.start()
+        return ctrl
+
+    def remove(self, name: str) -> None:
+        ctrl = self._controllers.pop(name, None)
+        if ctrl:
+            ctrl.stop()
+
+    def stop_all(self) -> None:
+        for ctrl in list(self._controllers.values()):
+            ctrl.stop()
+        self._controllers.clear()
+
+    def statuses(self):
+        return {n: c.status for n, c in self._controllers.items()}
+
+
+class Trigger:
+    """Debounced trigger (upstream ``pkg/trigger``): many calls within
+    ``min_interval`` coalesce into one invocation of ``fn``. ``sync=True``
+    runs inline (deterministic tests)."""
+
+    def __init__(self, fn: Callable[[], None], min_interval: float = 0.1,
+                 sync: bool = False):
+        self._fn = fn
+        self._min_interval = min_interval
+        self._sync = sync
+        self._lock = threading.Lock()
+        self._pending = False
+        self._timer: Optional[threading.Timer] = None
+        self.folds = 0     # calls coalesced
+
+    def __call__(self) -> None:
+        if self._sync:
+            self._fn()
+            return
+        with self._lock:
+            if self._pending:
+                self.folds += 1
+                return
+            self._pending = True
+            self._timer = threading.Timer(self._min_interval, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self) -> None:
+        with self._lock:
+            self._pending = False
+        self._fn()
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self._timer:
+                self._timer.cancel()
+            self._pending = False

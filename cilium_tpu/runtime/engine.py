@@ -1,0 +1,251 @@
+"""The Engine: the daemon's core object (analog of upstream cilium-agent's
+daemon wiring + endpoint regeneration pipeline, SURVEY.md §3.1/§3.2).
+
+Owns the control-plane state (allocator, ipcache, repository, endpoints),
+compiles PolicySnapshots, places them on device, and serves classification.
+
+Concurrency/atomicity model (the analog of per-endpoint policymap atomicity +
+regeneration revisions): the active compiled snapshot is swapped by a single
+reference assignment under a lock — a batch classifies against exactly one
+snapshot revision, never a torn update. Regeneration is driven by a debounced
+Trigger on repository/ipcache changes; CT sweeping by a periodic controller.
+Device arrays are a cache of host truth: on device loss the engine can
+re-materialize everything from host state (upstream philosophy: "BPF maps are
+re-populatable from agent state").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.snapshot import PolicySnapshot, build_snapshot
+from cilium_tpu.kernels.classify import make_classify_fn
+from cilium_tpu.kernels import conntrack as ctk
+from cilium_tpu.model.endpoint import Endpoint
+from cilium_tpu.model.identity import IdentityAllocator
+from cilium_tpu.model.ipcache import IPCache
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.rules import Rule, parse_rules
+from cilium_tpu.model.services import ServiceRegistry
+from cilium_tpu.policy.repository import PolicyContext, Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.controller import ControllerManager, Trigger
+from cilium_tpu.runtime.flowlog import FlowLog
+from cilium_tpu.runtime.metrics import Metrics
+from cilium_tpu.utils import constants as C
+
+
+@dataclass
+class CompiledSnapshot:
+    """A snapshot placed on device: what a batch classifies against."""
+    snapshot: PolicySnapshot
+    tensors: Dict            # device arrays
+    world_index: int
+    revision: int
+
+
+class Engine:
+    def __init__(self, config: Optional[DaemonConfig] = None):
+        self.config = config or DaemonConfig()
+        self._select_backend()
+        import jax.numpy as jnp
+        self._jnp = jnp
+
+        alloc = IdentityAllocator()
+        self.ctx = PolicyContext(
+            allocator=alloc,
+            selector_cache=SelectorCache(alloc),
+            ipcache=IPCache(),
+            services=ServiceRegistry(),
+            enforcement_mode=self.config.enforcement_mode,
+            allow_localhost=self.config.allow_localhost,
+        )
+        self.repo = Repository(self.ctx)
+        self.endpoints: Dict[int, Endpoint] = {}
+        self._next_ep_id = 1
+
+        self.metrics = Metrics()
+        self.flowlog = FlowLog(self.config.flowlog_capacity,
+                               self.config.flowlog_mode)
+        self.controllers = ControllerManager()
+
+        self._lock = threading.RLock()
+        self._active: Optional[CompiledSnapshot] = None
+        self._dirty = True
+        self._ct = {k: jnp.asarray(v) for k, v in make_ct_arrays(
+            CTConfig(self.config.ct_capacity, self.config.probe_depth)).items()}
+        self._classify = make_classify_fn(
+            probe_depth=self.config.probe_depth,
+            v4_only=self.config.v4_only,
+            donate_ct=self.config.donate_ct)
+
+        self._regen_trigger = Trigger(self._mark_dirty_and_regen,
+                                      min_interval=self.config.regen_debounce_s,
+                                      sync=not self.config.auto_regen)
+        self.repo.add_observer(lambda rev: self._regen_trigger())
+        self.ctx.ipcache.add_observer(self._mark_dirty)
+
+    # -- backend selection ----------------------------------------------------
+    def _select_backend(self) -> None:
+        import os
+        if self.config.device == "cpu":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # -- endpoint lifecycle (thin pkg/endpoint analog) ------------------------
+    def add_endpoint(self, labels: Sequence[str], ips: Sequence[str] = (),
+                     ep_id: Optional[int] = None,
+                     enforcement: Optional[str] = None) -> Endpoint:
+        with self._lock:
+            if ep_id is None:
+                ep_id = self._next_ep_id
+            if ep_id in self.endpoints:
+                raise ValueError(f"endpoint {ep_id} already exists")
+            self._next_ep_id = max(self._next_ep_id, ep_id + 1)
+            lbls = Labels.parse(labels)
+            ident = self.ctx.allocator.allocate(lbls)
+            ep = Endpoint(ep_id=ep_id, labels=lbls, ips=tuple(ips),
+                          identity_id=ident.id, enforcement=enforcement)
+            self.endpoints[ep_id] = ep
+            for ip in ips:
+                prefix = f"{ip}/128" if ":" in ip else f"{ip}/32"
+                self.ctx.ipcache.upsert(prefix, ident.id)
+            self._mark_dirty()
+            return ep
+
+    def remove_endpoint(self, ep_id: int) -> bool:
+        with self._lock:
+            ep = self.endpoints.pop(ep_id, None)
+            if ep is None:
+                return False
+            for ip in ep.ips:
+                prefix = f"{ip}/128" if ":" in ip else f"{ip}/32"
+                self.ctx.ipcache.delete(prefix)
+            ident = self.ctx.allocator.get(ep.identity_id)
+            if ident is not None:
+                self.ctx.allocator.release(ident)
+            self._mark_dirty()
+            return True
+
+    # -- policy ----------------------------------------------------------------
+    def apply_policy(self, docs) -> int:
+        """Ingest CNP-style rule documents (list/dict/JSON string)."""
+        return self.repo.add(parse_rules(docs))
+
+    def replace_policy(self, match_labels: Sequence[str], docs) -> int:
+        return self.repo.replace_by_labels(Labels.parse(match_labels),
+                                           parse_rules(docs) if docs else [])
+
+    # -- regeneration (the loader path) ----------------------------------------
+    def _mark_dirty(self, *_args) -> None:
+        self._dirty = True
+
+    def _mark_dirty_and_regen(self) -> None:
+        self._dirty = True
+        if self.config.auto_regen:
+            try:
+                self.regenerate()
+            except Exception:
+                # controller-style isolation; next classify retries
+                pass
+
+    def regenerate(self, force: bool = False) -> CompiledSnapshot:
+        """Compile current control-plane state and swap it in atomically."""
+        jnp = self._jnp
+        with self._lock:
+            if not (self._dirty or force) and self._active is not None:
+                return self._active
+            with self.metrics.span("snapshot_compile").timer():
+                snap = build_snapshot(
+                    self.repo, self.ctx,
+                    sorted(self.endpoints.values(), key=lambda e: e.ep_id),
+                    CTConfig(self.config.ct_capacity, self.config.probe_depth))
+            with self.metrics.span("device_place").timer():
+                tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+            compiled = CompiledSnapshot(
+                snapshot=snap, tensors=tensors,
+                world_index=snap.world_index, revision=snap.revision)
+            self._active = compiled            # atomic swap (revision fence)
+            self._dirty = False
+            for ep in self.endpoints.values():
+                ep.policy_revision = snap.revision
+            self.metrics.set_gauge("policy_revision", snap.revision)
+            self.metrics.set_gauge("policy_image_bytes", snap.nbytes)
+            return compiled
+
+    @property
+    def active(self) -> CompiledSnapshot:
+        if self._active is None or self._dirty:
+            return self.regenerate()
+        return self._active
+
+    # -- datapath ---------------------------------------------------------------
+    def classify(self, batch: Dict[str, np.ndarray],
+                 now: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """Classify one batch (dict-of-arrays, kernels/records layout).
+        Returns the out pytree as numpy; CT and counters update internally."""
+        jnp = self._jnp
+        active = self.active
+        if now is None:
+            now = int(time.time())
+        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        with self.metrics.span("classify").timer():
+            out, new_ct, counters = self._classify(
+                active.tensors, self._ct, dev_batch, jnp.uint32(now),
+                jnp.int32(active.world_index))
+            self._ct = new_ct
+            out = {k: np.asarray(v) for k, v in out.items()}
+        self.metrics.add_batch(
+            {k: np.asarray(v) for k, v in counters.items()},
+            int(np.asarray(batch["valid"]).sum()))
+        self.flowlog.append_batch(batch, out, now,
+                                  active.snapshot.ep_ids)
+        return out
+
+    def sweep(self, now: Optional[int] = None) -> int:
+        """CT garbage collection (upstream ctmap GC)."""
+        if now is None:
+            now = int(time.time())
+        new_ct, n = ctk.ct_sweep(self._ct, self._jnp.uint32(now))
+        self._ct = new_ct
+        reclaimed = int(n)
+        self.metrics.set_gauge("ct_last_sweep_reclaimed", reclaimed)
+        return reclaimed
+
+    def start_background(self) -> None:
+        """Start the periodic controllers (sweep; more as they land)."""
+        self.controllers.update("ct-gc", lambda: self.sweep(),
+                                interval=self.config.sweep_interval_s)
+
+    def stop(self) -> None:
+        self.controllers.stop_all()
+        self._regen_trigger.cancel()
+
+    # -- introspection ----------------------------------------------------------
+    def ct_stats(self, now: Optional[int] = None) -> Dict[str, int]:
+        if now is None:
+            now = int(time.time())
+        expiry = np.asarray(self._ct["expiry"])
+        return {
+            "capacity": int(expiry.shape[0]),
+            "live": int((expiry > now).sum()),
+            "stale": int(((expiry > 0) & (expiry <= now)).sum()),
+        }
+
+    def ct_arrays(self) -> Dict[str, np.ndarray]:
+        """Host copy of the CT table (checkpoint/inspection)."""
+        return {k: np.asarray(v) for k, v in self._ct.items()}
+
+    def load_ct_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
+        jnp = self._jnp
+        expected = set(self._ct.keys())
+        if set(arrays.keys()) != expected:
+            raise ValueError(f"CT arrays mismatch: {sorted(arrays)} != "
+                             f"{sorted(expected)}")
+        self._ct = {k: jnp.asarray(v) for k, v in arrays.items()}

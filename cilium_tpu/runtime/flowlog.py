@@ -1,0 +1,86 @@
+"""Flow log ring (Hubble-lite: SURVEY.md §2 "Minimal analog: flow log with
+identity/verdict annotation"). Fixed-capacity host ring buffer of flow
+records appended per batch; renderable as JSON lines for the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import addr_to_str, words_to_addr
+
+
+class FlowLog:
+    def __init__(self, capacity: int = 16384, mode: str = "drops"):
+        self.capacity = capacity
+        self.mode = mode
+        self._lock = threading.Lock()
+        self._ring: List[Dict] = []
+        self._next = 0
+        self.total_seen = 0
+
+    def append_batch(self, batch: Dict[str, np.ndarray],
+                     out: Dict[str, np.ndarray], now: int,
+                     ep_ids: tuple) -> None:
+        if self.mode == "none":
+            return
+        allow = np.asarray(out["allow"])
+        reason = np.asarray(out["reason"])
+        status = np.asarray(out["status"])
+        rid = np.asarray(out["remote_identity"])
+        valid = np.asarray(batch["valid"])
+        if self.mode == "drops":
+            pick = valid & ~allow
+        else:
+            pick = valid
+        idxs = np.nonzero(pick)[0]
+        self.total_seen += int(valid.sum())
+        if idxs.size == 0:
+            return
+        src = np.asarray(batch["src"])
+        dst = np.asarray(batch["dst"])
+        records = []
+        for i in idxs:
+            ep_slot = int(batch["ep_slot"][i])
+            records.append({
+                "time": int(now),
+                "verdict": "FORWARDED" if allow[i] else "DROPPED",
+                "drop_reason": int(reason[i]),
+                "drop_reason_desc": C.DropReason(int(reason[i])).name,
+                "ct_state": C.CTStatus(int(status[i])).name,
+                "src_ip": addr_to_str(words_to_addr(src[i])),
+                "dst_ip": addr_to_str(words_to_addr(dst[i])),
+                "src_port": int(batch["sport"][i]),
+                "dst_port": int(batch["dport"][i]),
+                "proto": C.PROTO_NAMES.get(int(batch["proto"][i]),
+                                           str(int(batch["proto"][i]))),
+                "direction": C.DIR_NAMES[int(batch["direction"][i])],
+                "endpoint_id": ep_ids[ep_slot] if ep_slot < len(ep_ids) else -1,
+                "remote_identity": int(rid[i]),
+            })
+        with self._lock:
+            for rec in records:
+                if len(self._ring) < self.capacity:
+                    self._ring.append(rec)
+                else:
+                    self._ring[self._next] = rec
+                self._next = (self._next + 1) % self.capacity
+
+    def tail(self, n: int = 100) -> List[Dict]:
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                items = self._ring[:]
+            else:
+                items = self._ring[self._next:] + self._ring[:self._next]
+        return items[-n:]
+
+    def to_jsonl(self, n: int = 100) -> str:
+        return "\n".join(json.dumps(r) for r in self.tail(n))
+
+    def __len__(self) -> int:
+        return len(self._ring)

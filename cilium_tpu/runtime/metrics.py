@@ -1,0 +1,109 @@
+"""Metrics registry + Prometheus text rendering (analog of upstream
+``pkg/metrics`` for the agent and the ``metricsmap`` per-verdict datapath
+counters tensor — SURVEY.md §5: "counters tensor accumulated in-kernel
+(drops by reason × direction), scraped to Prometheus text format").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from cilium_tpu.utils import constants as C
+
+
+class SpanStat:
+    """Micro-span timing aggregate (upstream pkg/spanstat)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    class _Timer:
+        def __init__(self, stat):
+            self._stat = stat
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._stat.observe(time.perf_counter() - self._t0)
+
+    def timer(self) -> "_Timer":
+        return SpanStat._Timer(self)
+
+
+class Metrics:
+    """Accumulates device counter outputs + host-side spans/gauges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.by_reason_dir = np.zeros((512,), dtype=np.uint64)
+        self.insert_fail = 0
+        self.packets_total = 0
+        self.batches_total = 0
+        self.spans: Dict[str, SpanStat] = {}
+        self.gauges: Dict[str, float] = {}
+
+    def span(self, name: str) -> SpanStat:
+        with self._lock:
+            if name not in self.spans:
+                self.spans[name] = SpanStat()
+            return self.spans[name]
+
+    def add_batch(self, counters: Dict, n_valid: int) -> None:
+        with self._lock:
+            self.by_reason_dir += np.asarray(
+                counters["by_reason_dir"]).astype(np.uint64)
+            self.insert_fail += int(counters["insert_fail"])
+            self.packets_total += n_valid
+            self.batches_total += 1
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    # -- rendering -----------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            lines.append("# HELP ciliumtpu_datapath_verdicts_total Verdicts "
+                         "by drop reason and direction")
+            lines.append("# TYPE ciliumtpu_datapath_verdicts_total counter")
+            arr = self.by_reason_dir.reshape(256, 2)
+            for reason in np.nonzero(arr.sum(axis=1))[0]:
+                try:
+                    rname = C.DropReason(int(reason)).name
+                except ValueError:
+                    rname = str(int(reason))
+                for d in (0, 1):
+                    if arr[reason, d]:
+                        lines.append(
+                            f'ciliumtpu_datapath_verdicts_total{{reason="{rname}",'
+                            f'direction="{C.DIR_NAMES[d]}"}} {int(arr[reason, d])}')
+            lines.append("# TYPE ciliumtpu_ct_insert_fail_total counter")
+            lines.append(f"ciliumtpu_ct_insert_fail_total {self.insert_fail}")
+            lines.append("# TYPE ciliumtpu_packets_total counter")
+            lines.append(f"ciliumtpu_packets_total {self.packets_total}")
+            lines.append("# TYPE ciliumtpu_batches_total counter")
+            lines.append(f"ciliumtpu_batches_total {self.batches_total}")
+            for name, g in sorted(self.gauges.items()):
+                lines.append(f"# TYPE ciliumtpu_{name} gauge")
+                lines.append(f"ciliumtpu_{name} {g}")
+            for name, s in sorted(self.spans.items()):
+                lines.append(f"# TYPE ciliumtpu_{name}_seconds summary")
+                lines.append(f"ciliumtpu_{name}_seconds_count {s.count}")
+                lines.append(f"ciliumtpu_{name}_seconds_sum {s.total_s:.6f}")
+                lines.append(f"ciliumtpu_{name}_seconds_max {s.max_s:.6f}")
+        return "\n".join(lines) + "\n"

@@ -12,10 +12,14 @@ shape: parse → ipcache LPM → conntrack → policy ladder → CT create/updat
    Expired entries count as misses. Entries created by an allowed NEW packet.
 3. Policy: the MapState precedence ladder (deny-wins → most-specific allow →
    default deny iff direction enforced).
-4. L7-lite: entries with http rules mark the CT entry `redirect`; packets
-   carrying request tokens (method != NONE) on a redirect flow are matched
-   against the http rules each time (the per-request proxy-decision analog);
-   token-less packets (e.g. the TCP handshake) pass at L4.
+4. L7-lite: packets carrying request tokens (method != NONE) whose *current*
+   policy cell is REDIRECT are matched against that cell's http rules — for
+   NEW and ESTABLISHED flows alike (the per-request proxy-decision analog:
+   upstream's proxy applies the current rules to every request, including on
+   connections opened before a policy change). Token-less packets (e.g. the
+   TCP handshake) pass at L4. Established flows bypass L3/L4 policy (deny
+   included) via the CT hit, exactly like the datapath; only the L7 check
+   follows current rules.
 
 Batch semantics — THE CONTRACT FOR THE TPU KERNELS:
 - `sequential` mode: packets are processed one at a time, CT effects visible
@@ -37,7 +41,7 @@ from cilium_tpu.model.ipcache import lpm_lookup
 from cilium_tpu.policy.repository import EndpointPolicy
 from cilium_tpu.utils import constants as C
 
-CT_NO_L7 = 0  # l7_id value meaning "no redirect"
+
 
 
 # --------------------------------------------------------------------------- #
@@ -84,7 +88,6 @@ class CTEntry:
     expiry: int
     created: int
     flags: int = 0                  # CT_FLAG_*
-    redirect_l7_id: int = CT_NO_L7  # non-zero → L7-lite flow, id into l7 sets
     pkts_fwd: int = 0
     pkts_rev: int = 0
 
@@ -155,14 +158,13 @@ class ConntrackTable:
         else:
             e.pkts_fwd += 1
 
-    def create(self, p: PacketRecord, now: int, l7_id: int = CT_NO_L7) -> CTKey:
+    def create(self, p: PacketRecord, now: int) -> CTKey:
         key = self.fwd_key(p)
         flags = _flag_delta(p.proto, p.tcp_flags, is_reply=False)
         self.entries[key] = CTEntry(
             expiry=_entry_expiry(p.proto, flags, now),
             created=now,
             flags=flags,
-            redirect_l7_id=l7_id,
             pkts_fwd=1,
         )
         return key
@@ -202,70 +204,76 @@ class Oracle:
         self.policies = policies
         self.ipcache_entries = dict(ipcache_entries)
         self.ct = ct if ct is not None else ConntrackTable()
-        # l7 sets are interned per-policy at lookup time: id = index+1 into
-        # this list (0 = no redirect), shared across endpoints.
-        self.l7_sets: List[frozenset] = []
-        self._l7_index: Dict[frozenset, int] = {}
 
     # -- helpers ------------------------------------------------------------
-    def _l7_id(self, rules: frozenset) -> int:
-        idx = self._l7_index.get(rules)
-        if idx is None:
-            self.l7_sets.append(rules)
-            idx = len(self.l7_sets)  # 1-based; 0 = none
-            self._l7_index[rules] = idx
-        return idx
-
     def _remote_identity(self, p: PacketRecord) -> int:
         from cilium_tpu.utils.ip import addr_to_str
         remote = p.dst_addr if p.direction == C.DIR_EGRESS else p.src_addr
         return lpm_lookup(self.ipcache_entries, addr_to_str(remote))
 
-    def _policy_verdict(self, p: PacketRecord, remote_id: int):
-        """(allow, drop_reason, redirect, l7_id, matched_key)."""
+    def _evaluate(self, p: PacketRecord, remote_id: int):
+        """Current-policy evaluation → (enforced, lookup_result | None).
+        lookup_result is None when the direction is unenforced."""
         pol = self.policies.get(p.ep_id)
         if pol is None:
-            return False, C.DropReason.INVALID_IDENTITY, False, CT_NO_L7, None
+            return None  # unknown endpoint — fail closed
         dirpol = pol.direction(p.direction)
         if not dirpol.enforced:
-            return True, C.DropReason.OK, False, CT_NO_L7, None
-        res = dirpol.lookup(remote_id, p.proto, p.dst_port)
+            return (False, None)
+        return (True, dirpol.lookup(remote_id, p.proto, p.dst_port))
+
+    def _verdict_for(self, p: PacketRecord, remote_id: int, status: int
+                     ) -> Tuple[Verdict, bool]:
+        """(verdict, create_entry) against the current CT probe result."""
+        ev = self._evaluate(p, remote_id)
+        if ev is None:
+            return Verdict(False, C.DropReason.INVALID_IDENTITY, status,
+                           remote_id), False
+        enforced, res = ev
+        cell_redirect = (res is not None
+                         and res.decision == C.VERDICT_REDIRECT)
+        l7_fail = (cell_redirect and p.has_l7_tokens
+                   and not l7_match(res.entry.l7_rules, p.http_method,
+                                    p.http_path))
+        key = res.key if res is not None else None
+
+        if status != C.CTStatus.NEW:
+            # CT hit bypasses L3/L4 policy; only current-cell L7 applies.
+            if l7_fail:
+                return Verdict(False, C.DropReason.POLICY_L7, status,
+                               remote_id, redirect=True, matched_key=key), False
+            return Verdict(True, C.DropReason.OK, status, remote_id,
+                           redirect=cell_redirect, matched_key=key), False
+
+        if not enforced:
+            return Verdict(True, C.DropReason.OK, status, remote_id), True
         if res.decision == C.VERDICT_DENY:
-            return False, C.DropReason.POLICY_DENY, False, CT_NO_L7, res.key
+            return Verdict(False, C.DropReason.POLICY_DENY, status, remote_id,
+                           matched_key=key), False
         if res.decision == C.VERDICT_MISS:
-            return False, C.DropReason.POLICY, False, CT_NO_L7, res.key
-        if res.decision == C.VERDICT_REDIRECT:
-            l7_id = self._l7_id(res.entry.l7_rules)
-            if p.has_l7_tokens:
-                ok = l7_match(res.entry.l7_rules, p.http_method, p.http_path)
-                reason = C.DropReason.OK if ok else C.DropReason.POLICY_L7
-                return ok, reason, True, l7_id, res.key
-            return True, C.DropReason.OK, True, l7_id, res.key
-        return True, C.DropReason.OK, False, CT_NO_L7, res.key
+            return Verdict(False, C.DropReason.POLICY, status, remote_id,
+                           matched_key=key), False
+        if cell_redirect:
+            if l7_fail:
+                return Verdict(False, C.DropReason.POLICY_L7, status,
+                               remote_id, redirect=True, matched_key=key), False
+            return Verdict(True, C.DropReason.OK, status, remote_id,
+                           redirect=True, matched_key=key), True
+        return Verdict(True, C.DropReason.OK, status, remote_id,
+                       matched_key=key), True
 
     # -- sequential (true eBPF per-packet semantics) ------------------------
     def classify(self, p: PacketRecord, now: int) -> Verdict:
         remote_id = self._remote_identity(p)
         status, hit_key = self.ct.probe(p, now)
-
+        verdict, create = self._verdict_for(p, remote_id, status)
         if status != C.CTStatus.NEW:
-            entry = self.ct.entries[hit_key]
-            # Established L7-lite flows re-check tokens per request.
-            if entry.redirect_l7_id != CT_NO_L7 and p.has_l7_tokens:
-                rules = self.l7_sets[entry.redirect_l7_id - 1]
-                if not l7_match(rules, p.http_method, p.http_path):
-                    return Verdict(False, C.DropReason.POLICY_L7, status,
-                                   remote_id, redirect=True)
-            self.ct.update(hit_key, p, is_reply=(status == C.CTStatus.REPLY),
-                           now=now)
-            return Verdict(True, C.DropReason.OK, status, remote_id,
-                           redirect=entry.redirect_l7_id != CT_NO_L7)
-
-        allow, reason, redirect, l7_id, key = self._policy_verdict(p, remote_id)
-        if allow:
-            self.ct.create(p, now, l7_id=l7_id)
-        return Verdict(allow, reason, C.CTStatus.NEW, remote_id,
-                       redirect=redirect, matched_key=key)
+            if verdict.allow:
+                self.ct.update(hit_key, p,
+                               is_reply=(status == C.CTStatus.REPLY), now=now)
+        elif create:
+            self.ct.create(p, now)
+        return verdict
 
     def classify_batch_sequential(self, packets: List[PacketRecord],
                                   now: int) -> List[Verdict]:
@@ -275,32 +283,14 @@ class Oracle:
     def classify_batch_snapshot(self, packets: List[PacketRecord],
                                 now: int) -> List[Verdict]:
         # Phase 1: all verdicts against the CT snapshot at batch start.
-        # l7_ids[i] carries the policy-computed l7 id for NEW packets so
-        # phase 2 never re-runs the ladder.
         verdicts: List[Verdict] = []
         probes: List[Tuple[int, Optional[CTKey]]] = []
-        l7_ids: List[int] = []
         for p in packets:
             remote_id = self._remote_identity(p)
             status, hit_key = self.ct.probe(p, now)
             probes.append((status, hit_key))
-            if status != C.CTStatus.NEW:
-                l7_ids.append(CT_NO_L7)
-                entry = self.ct.entries[hit_key]
-                if entry.redirect_l7_id != CT_NO_L7 and p.has_l7_tokens:
-                    rules = self.l7_sets[entry.redirect_l7_id - 1]
-                    if not l7_match(rules, p.http_method, p.http_path):
-                        verdicts.append(Verdict(False, C.DropReason.POLICY_L7,
-                                                status, remote_id, redirect=True))
-                        continue
-                verdicts.append(Verdict(True, C.DropReason.OK, status, remote_id,
-                                        redirect=entry.redirect_l7_id != CT_NO_L7))
-            else:
-                allow, reason, redirect, l7_id, key = self._policy_verdict(
-                    p, remote_id)
-                l7_ids.append(l7_id)
-                verdicts.append(Verdict(allow, reason, C.CTStatus.NEW, remote_id,
-                                        redirect=redirect, matched_key=key))
+            verdict, _create = self._verdict_for(p, remote_id, status)
+            verdicts.append(verdict)
 
         # Phase 2: order-independent aggregate CT effects.
         #   For each touched key: flags |= OR of deltas; counters += sums;
@@ -309,39 +299,34 @@ class Oracle:
 
         def touch(key: CTKey):
             return agg.setdefault(key, {
-                "flag_delta": 0, "fwd": 0, "rev": 0,
-                "create": None, "l7_id": CT_NO_L7,
+                "flag_delta": 0, "fwd": 0, "rev": 0, "create": False,
             })
 
-        for p, v, (status, hit_key), l7_id in zip(packets, verdicts, probes,
-                                                  l7_ids):
-            if status == C.CTStatus.ESTABLISHED and v.allow:
+        for p, v, (status, hit_key) in zip(packets, verdicts, probes):
+            if not v.allow:
+                continue
+            if status == C.CTStatus.ESTABLISHED:
                 a = touch(hit_key)
                 a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, False)
                 a["fwd"] += 1
-            elif status == C.CTStatus.REPLY and v.allow:
+            elif status == C.CTStatus.REPLY:
                 a = touch(hit_key)
                 a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, True)
                 a["rev"] += 1
-            elif status == C.CTStatus.NEW and v.allow:
-                key = ConntrackTable.fwd_key(p)
-                a = touch(key)
+            else:
+                a = touch(ConntrackTable.fwd_key(p))
                 a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, False)
                 a["fwd"] += 1
-                if a["create"] is None:
-                    # l7 id of the *winning* (first) creator
-                    a["create"] = p
-                    a["l7_id"] = l7_id
+                a["create"] = True
 
         for key, a in agg.items():
             entry = self.ct.entries.get(key)
-            if entry is not None and entry.expiry <= now and a["create"] is not None:
+            if entry is not None and entry.expiry <= now and a["create"]:
                 entry = None  # expired slot is replaced, not updated
             if entry is None:
-                if a["create"] is None:
+                if not a["create"]:
                     continue
-                entry = CTEntry(expiry=0, created=now,
-                                redirect_l7_id=a["l7_id"])
+                entry = CTEntry(expiry=0, created=now)
                 self.ct.entries[key] = entry
             proto = key[4]
             entry.flags |= a["flag_delta"]

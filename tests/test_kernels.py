@@ -120,11 +120,11 @@ class TestCTKernel:
                 for i in range(4)]).items()}
         keys = ctk.ct_key_words_jnp(b)
         want = jnp.asarray([True] * 4)
-        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
-            ct, keys, want, jnp.zeros(4, jnp.int32), jnp.uint32(100))
+        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, want, jnp.uint32(100))
         assert (np.asarray(slot) >= 0).all() and not np.asarray(fail).any()
         ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(4, bool), want,
-                           jnp.uint32(100), new_keys=nk, new_l7=nl7,
+                           jnp.uint32(100), new_keys=nk,
                            new_created=ncr, zero_mask=zm)
         slot2 = ctk.ct_probe(ct2, keys, jnp.uint32(101))
         np.testing.assert_array_equal(np.asarray(slot2), np.asarray(slot))
@@ -134,9 +134,8 @@ class TestCTKernel:
         b = {k: jnp.asarray(v) for k, v in _mk_batch(
             4, [("10.0.0.1", "10.0.0.2", 7, 80, 6, 0)] * 4).items()}
         keys = ctk.ct_key_words_jnp(b)
-        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
-            ct, keys, jnp.asarray([True] * 4), jnp.zeros(4, jnp.int32),
-            jnp.uint32(100))
+        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, jnp.asarray([True] * 4), jnp.uint32(100))
         s = np.asarray(slot)
         assert (s == s[0]).all() and (s >= 0).all()
         assert int(np.asarray(zm).sum()) == 1  # exactly one slot claimed
@@ -148,9 +147,8 @@ class TestCTKernel:
         tuples = [("10.0.0.1", "10.0.0.2", 100 + i, 80, 6, 0) for i in range(12)]
         b = {k: jnp.asarray(v) for k, v in _mk_batch(12, tuples).items()}
         keys = ctk.ct_key_words_jnp(b)
-        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
-            ct, keys, jnp.asarray([True] * 12), jnp.zeros(12, jnp.int32),
-            jnp.uint32(100))
+        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, jnp.asarray([True] * 12), jnp.uint32(100))
         assert int(np.asarray(fail).sum()) >= 4  # 8 slots, 12 flows
         assert int(np.asarray(zm).sum()) == 8
 
@@ -161,10 +159,10 @@ class TestCTKernel:
         b = {k: jnp.asarray(v) for k, v in raw.items()}
         keys = ctk.ct_key_words_jnp(b)
         one = jnp.asarray([True])
-        nk, nl7, ncr, zm, slot, fail = ctk.ct_insert_new(
-            ct, keys, one, jnp.zeros(1, jnp.int32), jnp.uint32(100))
+        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+            ct, keys, one, jnp.uint32(100))
         ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(1, bool), one,
-                           jnp.uint32(100), new_keys=nk, new_l7=nl7,
+                           jnp.uint32(100), new_keys=nk,
                            new_created=ncr, zero_mask=zm)
         ct3, n = ctk.ct_sweep(ct2, jnp.uint32(100 + C.CT_LIFETIME_SYN + 1))
         assert int(n) == 1

@@ -1,0 +1,125 @@
+"""Multi-chip tests on the 8-fake-device CPU mesh (SURVEY.md §4: the standard
+JAX idiom for testing shard_map without TPUs): steering invariants, DP
+classify parity vs single-device, rule-axis sharding parity."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.snapshot import build_snapshot
+from cilium_tpu.kernels.classify import classify_step
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.parallel.mesh import (
+    flow_shard_of, make_mesh, make_sharded_classify_fn, pad_snapshot_tensors,
+    steer_batch, unsteer_outputs,
+)
+from cilium_tpu.utils import constants as C
+from tests.test_parity import (
+    build_world, extract_device_ct, oracle_live_ct, random_packet,
+)
+from oracle import Oracle
+
+
+@pytest.fixture(scope="module")
+def world():
+    ctx, repo, eps = build_world()
+    snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=4096))
+    return ctx, snap
+
+
+class TestSteering:
+    def test_directions_agree(self, world):
+        ctx, snap = world
+        rng = random.Random(3)
+        packets = [random_packet(rng, []) for _ in range(64)]
+        fwd = batch_from_records(packets, snap.ep_slot_of)
+        # reversed packets: swap addrs/ports, flip direction
+        rev = dict(fwd)
+        rev = {k: v.copy() for k, v in fwd.items()}
+        rev["src"], rev["dst"] = fwd["dst"].copy(), fwd["src"].copy()
+        rev["sport"], rev["dport"] = fwd["dport"].copy(), fwd["sport"].copy()
+        rev["direction"] = 1 - fwd["direction"]
+        np.testing.assert_array_equal(flow_shard_of(fwd, 4),
+                                      flow_shard_of(rev, 4))
+
+    def test_steer_roundtrip(self, world):
+        ctx, snap = world
+        rng = random.Random(4)
+        packets = [random_packet(rng, []) for _ in range(50)]
+        batch = batch_from_records(packets, snap.ep_slot_of, pad_to=64)
+        steered, scatter, per = steer_batch(batch, 4)
+        # every valid packet lands in its shard's region
+        shard = flow_shard_of(batch, 4)
+        for i in range(64):
+            if batch["valid"][i]:
+                assert steered["valid"][scatter[i]]
+                assert scatter[i] // per == shard[i]
+        # fake outputs roundtrip
+        out = {"x": np.arange(steered["valid"].shape[0], dtype=np.int64)}
+        back = unsteer_outputs(out, scatter)
+        for i in range(64):
+            if batch["valid"][i]:
+                assert back["x"][i] == scatter[i]
+
+
+def _run_mesh_parity(n_flow, n_rule, seed=5, n_batches=4, batch=96):
+    """Sharded classify over the mesh vs the oracle."""
+    rng = random.Random(seed)
+    ctx, repo, eps = build_world()
+    cap = 4096
+    snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=cap))
+    mesh = make_mesh(n_flow, n_rule)
+    tensors_np = pad_snapshot_tensors(snap.tensors(), n_rule)
+    tensors = {k: jnp.asarray(v) for k, v in tensors_np.items()}
+    ct = {k: jnp.asarray(v) for k, v in
+          make_ct_arrays(CTConfig(capacity=cap)).items()}
+    fn = make_sharded_classify_fn(mesh, donate_ct=False)
+    oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                    ctx.ipcache.snapshot())
+    prior = []
+    now = 1000
+    for bi in range(n_batches):
+        packets = [random_packet(rng, prior) for _ in range(batch)]
+        want = oracle.classify_batch_snapshot(packets, now)
+        raw = batch_from_records(packets, snap.ep_slot_of)
+        steered, scatter, per = steer_batch(raw, n_flow, per_shard=batch)
+        dev_batch = {k: jnp.asarray(v) for k, v in steered.items()}
+        out, ct, counters = fn(tensors, ct, dev_batch, jnp.uint32(now),
+                               jnp.int32(snap.world_index))
+        out_np = unsteer_outputs({k: np.asarray(v) for k, v in out.items()},
+                                 scatter)
+        for i, v in enumerate(want):
+            assert bool(out_np["allow"][i]) == v.allow, (n_flow, n_rule, bi, i)
+            assert int(out_np["reason"][i]) == int(v.drop_reason), \
+                (n_flow, n_rule, bi, i)
+            assert int(out_np["status"][i]) == int(v.ct_status), \
+                (n_flow, n_rule, bi, i)
+        # device CT across all shards == oracle live entries
+        assert extract_device_ct(ct, now) == oracle_live_ct(oracle, now)
+        # counters replicated + correct total
+        by = np.asarray(counters["by_reason_dir"]).reshape(256, 2)
+        n_valid = sum(1 for p in packets)
+        assert int(by.sum()) == n_valid
+        prior.extend(p for p, v in zip(packets, want)
+                     if v.allow and v.ct_status == C.CTStatus.NEW)
+        prior = prior[-150:]
+        now += 40
+
+
+class TestMeshParity:
+    def test_dp_4x1(self):
+        _run_mesh_parity(4, 1)
+
+    def test_dp_8x1(self):
+        _run_mesh_parity(8, 1, seed=6)
+
+    def test_rule_sharded_1x8(self):
+        _run_mesh_parity(1, 8, seed=7)
+
+    def test_combined_4x2(self):
+        _run_mesh_parity(4, 2, seed=8)

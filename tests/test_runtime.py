@@ -1,0 +1,218 @@
+"""Runtime tests: engine end-to-end (vs oracle), policy update fencing,
+checkpoint/resume flow survival, config layering, controllers, metrics,
+flow log."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.runtime.checkpoint import restore, save
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.controller import Controller, Trigger
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import Oracle, PacketRecord
+
+POLICY = [{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toCIDR": ["10.0.0.0/8"],
+                "toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]}],
+}]
+
+
+def small_engine(**kw):
+    kw.setdefault("ct_capacity", 4096)
+    kw.setdefault("auto_regen", False)
+    kw.setdefault("flowlog_mode", "all")
+    return Engine(DaemonConfig(**kw))
+
+
+def pkt(src, dst, sp, dp, proto=C.PROTO_TCP, flags=C.TCP_SYN, ep_id=1,
+        direction=C.DIR_EGRESS, method=C.HTTP_METHOD_ANY, path=b""):
+    s16, sv6 = parse_addr(src)
+    d16, dv6 = parse_addr(dst)
+    return PacketRecord(s16, d16, sp, dp, proto, flags, sv6 or dv6, ep_id,
+                        direction, method, path)
+
+
+class TestEngine:
+    def test_end_to_end_matches_oracle(self):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        active = eng.active
+        oracle = Oracle(dict(zip(active.snapshot.ep_ids,
+                                 active.snapshot.policies)),
+                        eng.ctx.ipcache.snapshot())
+        packets = [
+            pkt("192.168.1.10", "10.1.2.3", 40000, 443),
+            pkt("192.168.1.10", "10.1.2.3", 40000, 443, flags=C.TCP_ACK),
+            pkt("192.168.1.10", "10.1.2.3", 40001, 80),
+            pkt("192.168.1.10", "8.8.8.8", 40002, 443),
+        ]
+        want = oracle.classify_batch_snapshot(packets, 100)
+        out = eng.classify(batch_from_records(packets, active.snapshot.ep_slot_of),
+                           now=100)
+        for i, v in enumerate(want):
+            assert bool(out["allow"][i]) == v.allow
+            assert int(out["reason"][i]) == int(v.drop_reason)
+        assert eng.ct_stats(now=100)["live"] == 1
+        assert eng.metrics.packets_total == 4
+
+    def test_policy_update_revision_fence(self):
+        """Snapshot swap: new rules take effect for NEW flows; established
+        flows keep passing via CT (the connection-survival contract)."""
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        snap0 = eng.active
+        slot_of = snap0.snapshot.ep_slot_of
+        # establish a flow on 443
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443)], slot_of), now=100)
+        assert bool(out["allow"][0])
+        rev0 = snap0.revision
+        # replace policy: now only port 80 is allowed
+        eng.repo.clear()
+        eng.apply_policy([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toCIDR": ["10.0.0.0/8"],
+                        "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}]}]}],
+        }])
+        snap1 = eng.active
+        assert snap1.revision > rev0
+        # established flow still forwarded (CT bypass)
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443, flags=C.TCP_ACK)],
+            slot_of), now=101)
+        assert bool(out["allow"][0])
+        assert int(out["status"][0]) == C.CTStatus.ESTABLISHED
+        # a NEW flow to 443 now drops; to 80 passes
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 41000, 443),
+             pkt("192.168.1.10", "10.1.2.3", 41001, 80)], slot_of), now=102)
+        assert not bool(out["allow"][0]) and bool(out["allow"][1])
+
+    def test_sweep_controller(self):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        slot_of = eng.active.snapshot.ep_slot_of
+        eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443)], slot_of), now=100)
+        assert eng.sweep(now=100 + C.CT_LIFETIME_SYN + 1) == 1
+        assert eng.ct_stats(now=200)["live"] == 0
+
+    def test_flowlog_and_metrics(self):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        slot_of = eng.active.snapshot.ep_slot_of
+        eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443),
+             pkt("192.168.1.10", "10.1.2.3", 40001, 22)], slot_of), now=100)
+        logs = eng.flowlog.tail()
+        assert len(logs) == 2
+        drop = [l for l in logs if l["verdict"] == "DROPPED"][0]
+        assert drop["dst_port"] == 22 and drop["drop_reason_desc"] == "POLICY"
+        text = eng.metrics.render_prometheus()
+        assert 'reason="OK",direction="egress"} 1' in text
+        assert 'reason="POLICY"' in text
+
+    def test_unenforced_endpoint_allows(self):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=lonely"], ips=("192.168.1.99",), ep_id=5)
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.99", "8.8.8.8", 40000, 443, ep_id=5)],
+            eng.active.snapshot.ep_slot_of), now=100)
+        assert bool(out["allow"][0])
+
+
+class TestCheckpoint:
+    def test_flows_survive_restart(self, tmp_path):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        slot_of = eng.active.snapshot.ep_slot_of
+        eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443)], slot_of), now=100)
+        cidr_id = eng.ctx.ipcache.lookup("10.1.2.3")
+        save(eng, str(tmp_path / "ckpt"))
+
+        eng2 = small_engine()
+        restore(eng2, str(tmp_path / "ckpt"))
+        # identity numbering stable
+        assert eng2.ctx.ipcache.lookup("10.1.2.3") == cidr_id
+        # the established flow survives the "restart": ACK is ESTABLISHED,
+        # not NEW (the pinned-map analog)
+        out = eng2.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443, flags=C.TCP_ACK)],
+            eng2.active.snapshot.ep_slot_of), now=105)
+        assert bool(out["allow"][0])
+        assert int(out["status"][0]) == C.CTStatus.ESTABLISHED
+
+    def test_restore_requires_fresh_engine(self, tmp_path):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ep_id=1)
+        save(eng, str(tmp_path / "c"))
+        eng2 = small_engine()
+        eng2.add_endpoint(["k8s:app=other"], ep_id=9)
+        with pytest.raises(ValueError):
+            restore(eng2, str(tmp_path / "c"))
+
+
+class TestConfig:
+    def test_env_overrides_file(self, tmp_path):
+        cfg_file = tmp_path / "cfg.json"
+        cfg_file.write_text(json.dumps({"ct_capacity": 4096,
+                                        "enforcement_mode": "default"}))
+        cfg = DaemonConfig.load(
+            config_file=str(cfg_file),
+            env={"CILIUM_TPU_ENFORCEMENT_MODE": "always"},
+            argv=["--batch-size", "128"])
+        assert cfg.ct_capacity == 4096
+        assert cfg.enforcement_mode == "always"
+        assert cfg.batch_size == 128
+
+    def test_rejects_unknown_keys(self, tmp_path):
+        cfg_file = tmp_path / "cfg.json"
+        cfg_file.write_text(json.dumps({"bogus": 1}))
+        with pytest.raises(ValueError):
+            DaemonConfig.load(config_file=str(cfg_file), env={})
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DaemonConfig(ct_capacity=1000)
+        with pytest.raises(ValueError):
+            DaemonConfig(enforcement_mode="sometimes")
+
+
+class TestControllers:
+    def test_retry_with_backoff_counts(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+
+        ctrl = Controller("test", flaky, interval=0.01, backoff_base=0.001)
+        for _ in range(3):
+            ctrl.run_once()
+        assert ctrl.status.failure_count == 2
+        assert ctrl.status.success_count == 1
+        assert ctrl.status.consecutive_failures == 0
+
+    def test_trigger_debounce(self):
+        fired = []
+        trig = Trigger(lambda: fired.append(1), min_interval=0.05)
+        for _ in range(10):
+            trig()
+        time.sleep(0.15)
+        assert len(fired) == 1
+        assert trig.folds == 9
