@@ -1,0 +1,4 @@
+"""Native ingress shim + golden-vector generator (C++, ctypes-bound).
+
+Build with ``make -C cilium_tpu/shim`` (or ``make shim`` at repo root).
+"""

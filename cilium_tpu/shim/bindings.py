@@ -1,0 +1,248 @@
+"""ctypes bindings for the C++ shim + goldengen scenario IO.
+
+The shim's batch output converts straight into the ``kernels/records``
+dict-of-arrays layout, so tests and pcap replay drive the same path the
+AF_XDP front end would: frames → shim parse/batch → device classify →
+verdict bitmap → shim_apply_verdicts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.utils import constants as C
+
+_SHIM_DIR = os.path.dirname(os.path.abspath(__file__))
+LIB_PATH = os.path.join(_SHIM_DIR, "libflowshim.so")
+GOLDENGEN_PATH = os.path.join(_SHIM_DIR, "goldengen")
+
+
+class ShimRecord(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("src", ctypes.c_uint32 * 4),
+        ("dst", ctypes.c_uint32 * 4),
+        ("sport", ctypes.c_uint16),
+        ("dport", ctypes.c_uint16),
+        ("proto", ctypes.c_uint8),
+        ("tcp_flags", ctypes.c_uint8),
+        ("is_v6", ctypes.c_uint8),
+        ("direction", ctypes.c_uint8),
+        ("ep_id", ctypes.c_uint32),
+        ("frame_idx", ctypes.c_uint32),
+        ("orig_len", ctypes.c_uint32),
+        ("pad", ctypes.c_uint8 * 12),
+    ]
+
+
+class ShimTokens(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("has_tokens", ctypes.c_uint8),
+        ("method", ctypes.c_uint8),
+        ("path_len", ctypes.c_uint16),
+        ("path", ctypes.c_uint8 * 64),
+        ("pad", ctypes.c_uint8 * 4),
+    ]
+
+
+class ShimStats(ctypes.Structure):
+    _fields_ = [(n, ctypes.c_uint64) for n in (
+        "frames_seen", "frames_parsed", "parse_errors", "batches_emitted",
+        "records_emitted", "verdict_drops", "verdict_passes")]
+
+
+def _load_lib():
+    if not os.path.exists(LIB_PATH):
+        raise FileNotFoundError(
+            f"{LIB_PATH} not built — run `make -C cilium_tpu/shim`")
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.shim_create.restype = ctypes.c_void_p
+    lib.shim_create.argtypes = [ctypes.c_uint32, ctypes.c_uint64]
+    lib.shim_destroy.argtypes = [ctypes.c_void_p]
+    lib.shim_register_endpoint.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.shim_feed_frame.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.shim_poll_batch.restype = ctypes.c_uint32
+    lib.shim_poll_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ShimRecord), ctypes.POINTER(ShimTokens)]
+    lib.shim_apply_verdicts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.shim_get_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimStats)]
+    lib.shim_flow_shard.restype = ctypes.c_uint32
+    lib.shim_flow_shard.argtypes = [ctypes.POINTER(ShimRecord), ctypes.c_uint32]
+    lib.shim_afxdp_bind.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    return lib
+
+
+class FlowShim:
+    """Python handle on the native shim (mock-driver mode unless afxdp_bind
+    succeeds)."""
+
+    def __init__(self, batch_size: int = 256, timeout_us: int = 500):
+        self._lib = _load_lib()
+        self._handle = self._lib.shim_create(batch_size, timeout_us)
+        self.batch_size = batch_size
+        self._rec_buf = (ShimRecord * batch_size)()
+        self._tok_buf = (ShimTokens * batch_size)()
+
+    def close(self):
+        if self._handle:
+            self._lib.shim_destroy(self._handle)
+            self._handle = None
+
+    def register_endpoint(self, ip: str, ep_id: int) -> None:
+        from cilium_tpu.utils.ip import parse_addr
+        addr16, _ = parse_addr(ip)
+        self._lib.shim_register_endpoint(self._handle, addr16, ep_id)
+
+    def feed_frame(self, frame: bytes, now_us: int = 0) -> bool:
+        return self._lib.shim_feed_frame(
+            self._handle, frame, len(frame), now_us) == 0
+
+    def poll_batch(self, now_us: int = 0, force: bool = False
+                   ) -> Optional[Dict[str, np.ndarray]]:
+        """Harvest a batch in the kernels/records layout (None if not ready).
+        Records for unknown endpoints (ep_id 0) stay invalid (fail closed)."""
+        n = self._lib.shim_poll_batch(self._handle, now_us, int(force),
+                                      self._rec_buf, self._tok_buf)
+        if n == 0:
+            return None
+        b = empty_batch(self.batch_size)
+        b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
+        b["_frame_idx"] = np.zeros((self.batch_size,), dtype=np.int64)
+        for i in range(n):
+            r, t = self._rec_buf[i], self._tok_buf[i]
+            b["src"][i] = r.src[:]
+            b["dst"][i] = r.dst[:]
+            b["sport"][i] = r.sport
+            b["dport"][i] = r.dport
+            b["proto"][i] = r.proto
+            b["tcp_flags"][i] = r.tcp_flags
+            b["is_v6"][i] = bool(r.is_v6)
+            b["direction"][i] = r.direction
+            b["_ep_raw"][i] = r.ep_id
+            b["_frame_idx"][i] = r.frame_idx
+            if t.has_tokens:
+                b["http_method"][i] = t.method
+                b["http_path"][i, :t.path_len] = np.ctypeslib.as_array(
+                    t.path)[:t.path_len]
+            b["valid"][i] = r.ep_id != 0
+        return b
+
+    def apply_verdicts(self, allow: np.ndarray) -> None:
+        arr = np.ascontiguousarray(allow.astype(np.uint8))
+        self._lib.shim_apply_verdicts(self._handle, arr.tobytes(),
+                                      arr.shape[0])
+
+    def stats(self) -> Dict[str, int]:
+        s = ShimStats()
+        self._lib.shim_get_stats(self._handle, ctypes.byref(s))
+        return {n: getattr(s, n) for n, _ in ShimStats._fields_}
+
+    def flow_shard(self, rec_index: int, n_shards: int) -> int:
+        return self._lib.shim_flow_shard(
+            ctypes.byref(self._rec_buf[rec_index]), n_shards)
+
+    def afxdp_bind(self, ifname: str, queue: int = 0) -> int:
+        return self._lib.shim_afxdp_bind(self._handle, ifname.encode(), queue)
+
+
+# --------------------------------------------------------------------------- #
+# Test-frame builders (Ethernet/IP/TCP/UDP crafting for the mock driver)
+# --------------------------------------------------------------------------- #
+def build_frame(src_ip: str, dst_ip: str, sport: int, dport: int,
+                proto: int = C.PROTO_TCP, tcp_flags: int = C.TCP_SYN,
+                payload: bytes = b"", vlan: Optional[int] = None) -> bytes:
+    import ipaddress
+    src = ipaddress.ip_address(src_ip)
+    dst = ipaddress.ip_address(dst_ip)
+    eth = b"\x02\x00\x00\x00\x00\x01" + b"\x02\x00\x00\x00\x00\x02"
+    if vlan is not None:
+        eth += struct.pack(">HH", 0x8100, vlan)
+    if proto == C.PROTO_TCP:
+        l4 = struct.pack(">HHIIBBHHH", sport, dport, 0, 0, 5 << 4, tcp_flags,
+                         65535, 0, 0) + payload
+    elif proto in (C.PROTO_UDP, C.PROTO_SCTP):
+        l4 = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0) + payload
+    else:  # ICMP: dport as the type
+        l4 = struct.pack(">BBH", dport, 0, 0) + payload
+    if src.version == 4:
+        total = 20 + len(l4)
+        ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, total, 0, 0, 64, proto, 0,
+                         src.packed, dst.packed)
+        return eth + struct.pack(">H", 0x0800) + ip + l4
+    ip6 = struct.pack(">IHBB16s16s", 6 << 28, len(l4), proto, 64,
+                      src.packed, dst.packed)
+    return eth + struct.pack(">H", 0x86DD) + ip6 + l4
+
+
+def build_http_frame(src_ip: str, dst_ip: str, sport: int, dport: int,
+                     method: str, path: str) -> bytes:
+    payload = f"{method} {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+    return build_frame(src_ip, dst_ip, sport, dport, C.PROTO_TCP,
+                       C.TCP_ACK | C.TCP_PSH, payload)
+
+
+# --------------------------------------------------------------------------- #
+# goldengen scenario writer/runner (3-way parity)
+# --------------------------------------------------------------------------- #
+def write_scenario(path: str, ipcache_entries: Dict[str, int],
+                   enforced: Tuple[bool, bool],
+                   mapstate_entries: Sequence[Tuple],
+                   l7_sets: Sequence[Sequence[Tuple[int, bytes]]],
+                   packets: Sequence) -> None:
+    """mapstate_entries: (dir, deny, proto, identity, lo, hi, l7_set_1based);
+    l7_sets[i]: [(method_id_or_255, path_prefix_bytes)];
+    packets: oracle.PacketRecord + .now attribute via tuple (rec, now)."""
+    from cilium_tpu.utils.ip import parse_prefix
+    out = [b"CTPUGV01"]
+    out.append(struct.pack("<I", len(ipcache_entries)))
+    for prefix, ident in ipcache_entries.items():
+        # goldengen compares in the 128-bit v4-mapped space, same as here
+        addr16, plen, is_v6 = parse_prefix(prefix)
+        out.append(struct.pack("<16sHBBI", addr16, plen, int(is_v6), 0, ident))
+    out.append(struct.pack("<BB", int(enforced[0]), int(enforced[1])))
+    out.append(struct.pack("<I", len(mapstate_entries)))
+    for (d, deny, proto, ident, lo, hi, l7) in mapstate_entries:
+        out.append(struct.pack("<BBBBIHHHH", d, int(deny), proto, 0, ident,
+                               lo, hi, l7, 0))
+    out.append(struct.pack("<I", len(l7_sets)))
+    for rules in l7_sets:
+        out.append(struct.pack("<I", len(rules)))
+        for method, prefix in rules:
+            out.append(struct.pack("<BB64s", method, len(prefix),
+                                   prefix.ljust(64, b"\x00")))
+    out.append(struct.pack("<I", len(packets)))
+    for rec, now in packets:
+        out.append(struct.pack(
+            "<16s16sHHBBBBBBH64sI", rec.src_addr, rec.dst_addr, rec.src_port,
+            rec.dst_port, rec.proto, rec.tcp_flags, int(rec.is_ipv6),
+            rec.direction, int(rec.has_l7_tokens),
+            rec.http_method, len(rec.http_path),
+            rec.http_path.ljust(64, b"\x00"), now))
+    with open(path, "wb") as f:
+        f.write(b"".join(out))
+
+
+def run_goldengen(scenario_path: str, out_path: str) -> np.ndarray:
+    """Run the C++ generator → structured array of expected verdicts."""
+    if not os.path.exists(GOLDENGEN_PATH):
+        raise FileNotFoundError(
+            f"{GOLDENGEN_PATH} not built — run `make -C cilium_tpu/shim`")
+    subprocess.run([GOLDENGEN_PATH, scenario_path, out_path], check=True)
+    raw = np.fromfile(out_path, dtype=np.uint8).reshape(-1, 8)
+    return np.rec.fromarrays(
+        [raw[:, 0], raw[:, 1], raw[:, 2],
+         raw[:, 4:8].copy().view("<u4").reshape(-1)],
+        names="allow,reason,status,remote")

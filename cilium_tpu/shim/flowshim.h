@@ -1,0 +1,122 @@
+// flowshim: the native ingress front end (SURVEY.md §2 native checklist
+// item 2 — the userspace replacement for the XDP hook: "C++ AF_XDP shim
+// (umem/fill/completion rings, batch header extraction → pinned host
+// buffers → TPU transfer). No Python in the packet path.").
+//
+// Components:
+//  - parser: Ethernet/VLAN → IPv4/IPv6 → TCP/UDP/SCTP/ICMP header extraction
+//    into the fixed 64-byte record the classifier consumes, plus an HTTP
+//    request-line tokenizer filling a parallel 72-byte token record.
+//  - batcher: lock-free-ish ring accumulating records until batch_size or an
+//    adaptive deadline (p99-latency driven) elapses.
+//  - afxdp: AF_XDP socket setup via raw syscalls (UMEM + fill/completion/rx/tx
+//    rings). Compiles everywhere; at runtime it requires a privileged netns
+//    and an XDP-capable driver, so the library also exposes a mock-driver
+//    path (feed frames from memory) used by tests and pcap replay.
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in this image).
+
+#ifndef CILIUM_TPU_FLOWSHIM_H_
+#define CILIUM_TPU_FLOWSHIM_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------------------
+// Record layouts (must match cilium_tpu/kernels/records.py column order)
+// ---------------------------------------------------------------------------
+// 64-byte header record. Addresses are 16-byte normalized (IPv4 mapped to
+// ::ffff:a.b.c.d) and stored as four BIG-ENDIAN u32 words, matching the
+// device batch layout.
+typedef struct __attribute__((packed)) ShimRecord {
+  uint32_t src[4];     // big-endian words
+  uint32_t dst[4];     // big-endian words
+  uint16_t sport;      // host order
+  uint16_t dport;      // host order; ICMP: type
+  uint8_t proto;
+  uint8_t tcp_flags;
+  uint8_t is_v6;
+  uint8_t direction;   // 0 egress / 1 ingress (relative to the endpoint)
+  uint32_t ep_id;      // local endpoint id (0 = unclassified)
+  uint32_t frame_idx;  // umem frame / mock buffer index for verdict return
+  uint32_t orig_len;   // original frame length
+  uint8_t pad[12];
+} ShimRecord;  // == 64 bytes
+
+// 72-byte L7 token record (parallel array; only meaningful when has_tokens).
+typedef struct __attribute__((packed)) ShimTokens {
+  uint8_t has_tokens;  // 1 when an HTTP request line was recognized
+  uint8_t method;      // HTTP_METHOD id; 255 = none
+  uint16_t path_len;
+  uint8_t path[64];
+  uint8_t pad[4];
+} ShimTokens;  // == 72 bytes
+
+typedef struct ShimStats {
+  uint64_t frames_seen;
+  uint64_t frames_parsed;
+  uint64_t parse_errors;
+  uint64_t batches_emitted;
+  uint64_t records_emitted;
+  uint64_t verdict_drops;
+  uint64_t verdict_passes;
+} ShimStats;
+
+typedef struct Shim Shim;  // opaque
+
+// ---------------------------------------------------------------------------
+// Lifecycle
+// ---------------------------------------------------------------------------
+// Create a shim with a batching target: emit a batch when ``batch_size``
+// records accumulated OR ``timeout_us`` elapsed since the first record of the
+// batch (adaptive latency bound).
+Shim* shim_create(uint32_t batch_size, uint64_t timeout_us);
+void shim_destroy(Shim* s);
+
+// Register an endpoint IP → (ep_id). The parser classifies each frame's
+// direction by matching src/dst against registered endpoint addresses
+// (ip16 = 16-byte normalized address).
+int shim_register_endpoint(Shim* s, const uint8_t ip16[16], uint32_t ep_id);
+
+// ---------------------------------------------------------------------------
+// Mock-driver ingest (tests / pcap replay). Frames are raw Ethernet.
+// Returns 0 on success, -1 on parse error (counted in stats).
+// ---------------------------------------------------------------------------
+int shim_feed_frame(Shim* s, const uint8_t* frame, uint32_t len,
+                    uint64_t now_us);
+
+// Harvest a ready batch (either full or timed out as of ``now_us``).
+// Returns the number of records written into out_records/out_tokens
+// (caller-allocated, capacity = batch_size), or 0 if no batch is ready.
+// ``force`` flushes a partial batch regardless of deadline.
+uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
+                         ShimRecord* out_records, ShimTokens* out_tokens);
+
+// Return verdicts for a previously harvested batch: allow[i] == 0 → drop.
+// In AF_XDP mode this recycles/forwards umem frames; in mock mode it only
+// updates stats (and the test inspects them).
+void shim_apply_verdicts(Shim* s, const uint8_t* allow, uint32_t n);
+
+void shim_get_stats(const Shim* s, ShimStats* out);
+
+// ---------------------------------------------------------------------------
+// AF_XDP mode (privileged; returns -errno on failure, e.g. in containers
+// without NET_ADMIN — callers fall back to the mock driver)
+// ---------------------------------------------------------------------------
+int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id);
+// Drain up to ``budget`` frames from the AF_XDP rx ring into the batcher.
+int shim_afxdp_poll(Shim* s, uint32_t budget, uint64_t now_us);
+
+// RSS-style flow-shard steering (must match
+// cilium_tpu/parallel/mesh.flow_shard_of: XOR of fwd/rev murmur key hashes).
+uint32_t shim_flow_shard(const ShimRecord* rec, uint32_t n_shards);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // CILIUM_TPU_FLOWSHIM_H_

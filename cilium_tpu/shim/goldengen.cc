@@ -1,0 +1,355 @@
+// goldengen: independent C++ golden-vector generator (SURVEY.md §2 native
+// checklist item 3 — the BPF-unit-test-harness analog: "C++ golden-vector
+// generator replaying the same packet constructions").
+//
+// Reads a binary scenario (ipcache prefixes + MapState entries + L7 sets +
+// packet stream) and computes, with its OWN implementation of the verdict
+// contract (sequential eBPF semantics: LPM → CT → deny-wins /
+// most-specific-allow ladder → L7 → CT update), the expected verdict for
+// every packet. The parity suite runs three implementations against each
+// other: this generator, the Python oracle, and the TPU kernels.
+//
+// Scenario format (little-endian, see tests/test_goldengen.py writer):
+//   magic   "CTPUGV01"
+//   u32 n_ipcache;  n × { u8 addr[16]; u16 plen; u8 is_v6; u8 pad; u32 id }
+//   u8 enforced[2]              // egress, ingress
+//   u32 n_entries;  n × { u8 dir; u8 deny; u8 proto; u8 pad; u32 identity;
+//                         u16 port_lo; u16 port_hi; u16 l7_set; u16 pad }
+//   u32 n_l7sets;   per set: u32 n_rules × { u8 method; u8 path_len;
+//                                            u8 path[64] }
+//   u32 n_packets;  n × { u8 src[16]; u8 dst[16]; u16 sport; u16 dport;
+//                         u8 proto; u8 tcp_flags; u8 is_v6; u8 direction;
+//                         u8 has_tokens; u8 method; u16 path_len;
+//                         u8 path[64]; u32 now }
+// Output: n_packets × { u8 allow; u8 reason; u8 status; u8 pad; u32 remote }
+
+#include <stdint.h>
+#include <string.h>
+
+#include <algorithm>
+#include <array>
+#include <cstdio>
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+// --- constants mirroring utils/constants.py (independently stated) ---------
+constexpr uint32_t kWorld = 2;
+constexpr int kDeny = 133, kPolicy = 130, kL7 = 180, kOk = 0;
+constexpr int kNew = 0, kEst = 1, kReply = 2;
+constexpr int kFin = 1, kSyn = 2, kRst = 4;
+constexpr int kSeenNonSyn = 1, kTxClosing = 2, kRxClosing = 4;
+constexpr uint32_t kLifeSyn = 60, kLifeTcp = 21600, kLifeNon = 60, kLifeClose = 10;
+
+struct Prefix {
+  std::array<uint8_t, 16> addr;
+  int plen;
+  bool is_v6;
+  uint32_t id;
+};
+
+struct Entry {
+  int dir, deny, proto;
+  uint32_t identity;
+  int lo, hi, l7;
+};
+
+struct L7Rule {
+  int method;  // 255 = any
+  std::string path;
+};
+
+struct Packet {
+  std::array<uint8_t, 16> src, dst;
+  int sport, dport, proto, flags, is_v6, dir, has_tokens, method;
+  std::string path;
+  uint32_t now;
+};
+
+struct CTEntry {
+  uint32_t expiry = 0;
+  int flags = 0;
+};
+
+bool prefix_covers(const Prefix& p, const std::array<uint8_t, 16>& a) {
+  int full = p.plen / 8, rem = p.plen % 8;
+  if (memcmp(p.addr.data(), a.data(), full) != 0) return false;
+  if (rem == 0) return true;
+  uint8_t mask = uint8_t(0xFF << (8 - rem));
+  return (p.addr[full] & mask) == (a[full] & mask);
+}
+
+uint32_t lpm(const std::vector<Prefix>& table,
+             const std::array<uint8_t, 16>& addr, bool is_v6) {
+  int best_len = -1;
+  uint32_t best = kWorld;
+  for (const auto& p : table) {
+    if (p.is_v6 != is_v6) continue;
+    if (p.plen >= best_len + 1 && prefix_covers(p, addr)) {
+      if (p.plen > best_len) {
+        best_len = p.plen;
+        best = p.id;
+      }
+    }
+  }
+  return best;
+}
+
+int flag_delta(int proto, int tcp_flags, bool reply) {
+  if (proto != 6) return 0;
+  int d = 0;
+  if (tcp_flags & (kFin | kRst)) {
+    d |= reply ? kRxClosing : kTxClosing;
+    if (tcp_flags & kRst) d |= kTxClosing | kRxClosing;
+  }
+  if (!(tcp_flags & kSyn)) d |= kSeenNonSyn;
+  return d;
+}
+
+uint32_t lifetime(int proto, int flags) {
+  if (proto != 6) return kLifeNon;
+  if (flags & (kTxClosing | kRxClosing)) return kLifeClose;
+  if (flags & kSeenNonSyn) return kLifeTcp;
+  return kLifeSyn;
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+  template <typename T>
+  T get() {
+    T v{};
+    if (p + sizeof(T) > end) {
+      fail = true;
+      return v;
+    }
+    memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+  void bytes(void* out, size_t n) {
+    if (p + n > end) {
+      fail = true;
+      return;
+    }
+    memcpy(out, p, n);
+    p += n;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: goldengen <scenario.bin> <out.bin>\n");
+    return 2;
+  }
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) {
+    perror("open scenario");
+    return 2;
+  }
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf(sz);
+  if (fread(buf.data(), 1, sz, f) != size_t(sz)) {
+    fclose(f);
+    return 2;
+  }
+  fclose(f);
+
+  Reader rd{buf.data(), buf.data() + buf.size()};
+  char magic[8];
+  rd.bytes(magic, 8);
+  if (rd.fail || memcmp(magic, "CTPUGV01", 8) != 0) {
+    fprintf(stderr, "bad magic\n");
+    return 2;
+  }
+
+  std::vector<Prefix> ipcache(rd.get<uint32_t>());
+  for (auto& p : ipcache) {
+    rd.bytes(p.addr.data(), 16);
+    p.plen = rd.get<uint16_t>();
+    p.is_v6 = rd.get<uint8_t>();
+    rd.get<uint8_t>();
+    p.id = rd.get<uint32_t>();
+  }
+  uint8_t enforced[2];
+  rd.bytes(enforced, 2);
+  std::vector<Entry> entries(rd.get<uint32_t>());
+  for (auto& e : entries) {
+    e.dir = rd.get<uint8_t>();
+    e.deny = rd.get<uint8_t>();
+    e.proto = rd.get<uint8_t>();
+    rd.get<uint8_t>();
+    e.identity = rd.get<uint32_t>();
+    e.lo = rd.get<uint16_t>();
+    e.hi = rd.get<uint16_t>();
+    e.l7 = rd.get<uint16_t>();
+    rd.get<uint16_t>();
+  }
+  std::vector<std::vector<L7Rule>> l7sets(rd.get<uint32_t>());
+  for (auto& set : l7sets) {
+    set.resize(rd.get<uint32_t>());
+    for (auto& r : set) {
+      r.method = rd.get<uint8_t>();
+      int n = rd.get<uint8_t>();
+      char pathbuf[64];
+      rd.bytes(pathbuf, 64);
+      r.path.assign(pathbuf, pathbuf + n);
+    }
+  }
+  std::vector<Packet> packets(rd.get<uint32_t>());
+  for (auto& pk : packets) {
+    rd.bytes(pk.src.data(), 16);
+    rd.bytes(pk.dst.data(), 16);
+    pk.sport = rd.get<uint16_t>();
+    pk.dport = rd.get<uint16_t>();
+    pk.proto = rd.get<uint8_t>();
+    pk.flags = rd.get<uint8_t>();
+    pk.is_v6 = rd.get<uint8_t>();
+    pk.dir = rd.get<uint8_t>();
+    pk.has_tokens = rd.get<uint8_t>();
+    pk.method = rd.get<uint8_t>();
+    int n = rd.get<uint16_t>();
+    char pathbuf[64];
+    rd.bytes(pathbuf, 64);
+    pk.path.assign(pathbuf, pathbuf + std::min(n, 64));
+    pk.now = rd.get<uint32_t>();
+  }
+  if (rd.fail) {
+    fprintf(stderr, "truncated scenario\n");
+    return 2;
+  }
+
+  // --- sequential datapath ---------------------------------------------------
+  using CTKey = std::array<uint8_t, 38>;  // src16 dst16 sport2 dport2 proto dir
+  auto make_key = [](const Packet& pk, bool rev) {
+    CTKey k{};
+    const auto& s = rev ? pk.dst : pk.src;
+    const auto& d = rev ? pk.src : pk.dst;
+    int sp = rev ? pk.dport : pk.sport, dp = rev ? pk.sport : pk.dport;
+    int dir = rev ? 1 - pk.dir : pk.dir;
+    memcpy(k.data(), s.data(), 16);
+    memcpy(k.data() + 16, d.data(), 16);
+    k[32] = sp >> 8;
+    k[33] = sp & 0xFF;
+    k[34] = dp >> 8;
+    k[35] = dp & 0xFF;
+    k[36] = uint8_t(pk.proto);
+    k[37] = uint8_t(dir);
+    return k;
+  };
+  std::map<CTKey, CTEntry> ct;
+
+  FILE* out = fopen(argv[2], "wb");
+  if (!out) {
+    perror("open out");
+    return 2;
+  }
+
+  for (const auto& pk : packets) {
+    uint32_t remote =
+        lpm(ipcache, pk.dir == 0 ? pk.dst : pk.src, pk.is_v6 != 0);
+
+    // CT probe
+    CTKey fwd = make_key(pk, false), rev = make_key(pk, true);
+    int status = kNew;
+    CTKey* hit = nullptr;
+    auto itf = ct.find(fwd);
+    if (itf != ct.end() && itf->second.expiry > pk.now) {
+      status = kEst;
+      hit = &fwd;
+    } else {
+      auto itr = ct.find(rev);
+      if (itr != ct.end() && itr->second.expiry > pk.now) {
+        status = kReply;
+        hit = &rev;
+      }
+    }
+
+    // policy ladder against the sparse entries (deny wins; then most
+    // specific: (spec, -width, port_lo) — the documented total order)
+    int decision = 0;  // 0 miss 1 allow 2 deny 3 redirect
+    int best_l7 = 0;
+    bool dir_enforced = enforced[pk.dir] != 0;
+    if (dir_enforced) {
+      long best_rank = -1;
+      bool denied = false;
+      for (const auto& e : entries) {
+        if (e.dir != pk.dir) continue;
+        if (e.identity != 0 && e.identity != remote) continue;
+        if (e.proto != 0 && e.proto != pk.proto) continue;
+        if (pk.dport < e.lo || pk.dport > e.hi) continue;
+        if (e.deny) {
+          denied = true;
+          continue;
+        }
+        int spec = (e.identity != 0) * 4 + (e.proto != 0) * 2 +
+                   !(e.lo == 0 && e.hi == 65535);
+        long rank = (long(spec) << 33) |
+                    (long(65535 - (e.hi - e.lo)) << 16) | e.lo;
+        if (rank > best_rank) {
+          best_rank = rank;
+          decision = e.l7 > 0 ? 3 : 1;
+          best_l7 = e.l7;
+        }
+      }
+      if (denied) decision = 2;
+      else if (best_rank < 0) decision = 0;
+    }
+
+    bool l7_fail = false;
+    if (decision == 3 && pk.has_tokens) {
+      bool ok = false;
+      for (const auto& r : l7sets[best_l7 - 1]) {
+        bool m_ok = r.method == 255 || r.method == pk.method;
+        if (m_ok && pk.path.compare(0, r.path.size(), r.path) == 0) {
+          ok = true;
+          break;
+        }
+      }
+      l7_fail = !ok;
+    }
+
+    uint8_t allow, reason;
+    if (status != kNew) {
+      allow = l7_fail ? 0 : 1;
+      reason = l7_fail ? kL7 : kOk;
+      if (allow) {
+        CTEntry& e = ct[*hit];
+        e.flags |= flag_delta(pk.proto, pk.flags, status == kReply);
+        e.expiry = pk.now + lifetime(pk.proto, e.flags);
+      }
+    } else if (!dir_enforced) {
+      allow = 1;
+      reason = kOk;
+    } else if (decision == 2) {
+      allow = 0;
+      reason = kDeny;
+    } else if (decision == 0) {
+      allow = 0;
+      reason = kPolicy;
+    } else {
+      allow = l7_fail ? 0 : 1;
+      reason = l7_fail ? kL7 : kOk;
+    }
+    if (status == kNew && allow) {
+      CTEntry e;
+      e.flags = flag_delta(pk.proto, pk.flags, false);
+      e.expiry = pk.now + lifetime(pk.proto, e.flags);
+      ct[fwd] = e;
+    }
+
+    uint8_t rec[8] = {allow, reason, uint8_t(status), 0};
+    memcpy(rec + 4, &remote, 4);
+    fwrite(rec, 1, 8, out);
+  }
+  fclose(out);
+  return 0;
+}
