@@ -12,3 +12,11 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize.py in some environments registers a TPU PJRT plugin and
+# overrides jax_platforms after import, defeating the env vars above. Pin the
+# config explicitly — this must happen before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
