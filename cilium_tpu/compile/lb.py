@@ -1,0 +1,314 @@
+"""Service load-balancer tensors — the lbmap analog (SURVEY.md §2
+"Services/LB": upstream ``pkg/service`` programs ``pkg/maps/lbmap``; the
+datapath consumes it in ``bpf/lib/lb.h`` — lb4_lookup_service →
+lb4_select_backend → DNAT, reverse NAT via the revnat map).
+
+TPU-native layout:
+
+- **Frontend table**: open-addressed hash table over (addr[4 words], port,
+  proto) → frontend index, probed exactly like the conntrack table (same
+  murmur mix, fixed probe depth). Built host-side; capacity grows until every
+  key fits inside the probe window, so device lookups are bounded.
+- **Maglev tables**: one row per service, ``[n_services, M]`` (M prime) of
+  global backend indices — consistent hashing so backend churn re-steers
+  ~1/B of flows (upstream: pkg/loadbalancer Maglev). Weighted backends take
+  proportionally many table slots.
+- **Backend arrays**: ``be_addr [B,4]``, ``be_port [B]``.
+- **Rev-NAT arrays**: per frontend VIP/port, gathered on the reply path to
+  un-DNAT (upstream: lb4_rev_nat via the CT entry's rev_nat_index).
+
+Backend selection is **stateless-deterministic**: hash of the un-translated
+5-tuple mod M. The same flow always picks the same backend while the backend
+set is unchanged; on backend change Maglev bounds re-steering. (Upstream
+additionally pins a flow's backend in a CT_SERVICE entry; the stateless form
+is the TPU-friendly equivalent and is what the oracle specifies.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.kernels.hashing import hash_words_np
+from cilium_tpu.model.services import Backend, Frontend, Service
+from cilium_tpu.utils.ip import addr_to_words, parse_addr
+
+FE_KEY_WORDS = 6          # addr[4], port, proto
+LB_PROBE_DEPTH = 8
+MAGLEV_M_DEFAULT = 251    # prime; production-sized tables use 16381
+
+
+@dataclass(frozen=True)
+class LBConfig:
+    maglev_m: int = MAGLEV_M_DEFAULT
+    probe_depth: int = LB_PROBE_DEPTH
+
+
+@dataclass(frozen=True)
+class LBTables:
+    """Compiled LB state. Device-facing arrays + host metadata.
+
+    Rev-NAT ids are STABLE across snapshots (allocated by the
+    ServiceRegistry, never reused): CT entries store ``rnat_id + 1`` and the
+    reply path resolves it against ``rnat_addr/rnat_port/rnat_valid``, which
+    are indexed by id — a service deleted between snapshots leaves its row
+    invalid, so stale CT entries fail closed (no rewrite) instead of
+    rewriting to another service's VIP."""
+    tab_keys: np.ndarray        # [cap, 6] uint32 — 0-key = empty
+    tab_val: np.ndarray         # [cap] int32 frontend idx (-1 empty)
+    fe_service: np.ndarray      # [F] int32 → maglev row
+    fe_rnat_id: np.ndarray      # [F] int32 stable rev-NAT id
+    rnat_addr: np.ndarray       # [R, 4] uint32 (the VIP), indexed by id
+    rnat_port: np.ndarray       # [R] int32
+    rnat_valid: np.ndarray      # [R] bool
+    maglev: np.ndarray          # [S, M] int32 global backend idx (-1 = none)
+    be_addr: np.ndarray         # [B, 4] uint32
+    be_port: np.ndarray         # [B] int32
+    probe_depth: int
+    # host-side metadata (CLI / oracle / trace)
+    frontends: Tuple[Frontend, ...]
+    fe_names: Tuple[str, ...]   # "namespace/name" per frontend
+    backends: Tuple[Backend, ...]
+
+    @property
+    def n_frontends(self) -> int:
+        return len(self.frontends)
+
+    def tensors(self) -> Dict[str, np.ndarray]:
+        return {
+            "lb_tab_keys": self.tab_keys,
+            "lb_tab_val": self.tab_val,
+            "lb_fe_service": self.fe_service,
+            "lb_fe_rnat_id": self.fe_rnat_id,
+            "lb_rnat_addr": self.rnat_addr,
+            "lb_rnat_port": self.rnat_port,
+            "lb_rnat_valid": self.rnat_valid,
+            "lb_maglev": self.maglev,
+            "lb_be_addr": self.be_addr,
+            "lb_be_port": self.be_port,
+        }
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    for p in range(2, int(n ** 0.5) + 1):
+        if n % p == 0:
+            return False
+    return True
+
+
+def _fe_key_words(addr16: bytes, port: int, proto: int) -> np.ndarray:
+    w = addr_to_words(addr16)
+    return np.array([w[0], w[1], w[2], w[3], port, proto], dtype=np.uint32)
+
+
+def _str_hash_words(s: str) -> np.ndarray:
+    data = s.encode()
+    data += b"\x00" * (-len(data) % 4)
+    return np.frombuffer(data, dtype="<u4").astype(np.uint32)
+
+
+def maglev_table(backends: Sequence[Backend], m: int) -> np.ndarray:
+    """Standard Maglev population (the upstream pkg/loadbalancer algorithm
+    shape): each backend gets a permutation of [0, M) from (offset, skip)
+    derived from its name hash; backends take turns claiming their next
+    unclaimed slot, weighted backends take ``weight`` consecutive turns."""
+    if not _is_prime(m):
+        raise ValueError(f"maglev M must be prime, got {m}")
+    n = len(backends)
+    if n == 0:
+        return np.full((m,), -1, dtype=np.int32)
+    offsets = np.empty(n, dtype=np.int64)
+    skips = np.empty(n, dtype=np.int64)
+    for i, b in enumerate(backends):
+        name = f"{b.addr}:{b.port}"
+        h1 = int(hash_words_np(_str_hash_words(name + "#o"))[()])
+        h2 = int(hash_words_np(_str_hash_words(name + "#s"))[()])
+        offsets[i] = h1 % m
+        skips[i] = h2 % (m - 1) + 1
+    table = np.full((m,), -1, dtype=np.int32)
+    next_idx = np.zeros(n, dtype=np.int64)
+    filled = 0
+    while filled < m:
+        for i, b in enumerate(backends):
+            for _ in range(b.weight):
+                # claim the backend's next unclaimed permutation slot
+                while True:
+                    c = (offsets[i] + next_idx[i] * skips[i]) % m
+                    next_idx[i] += 1
+                    if table[c] < 0:
+                        table[c] = i
+                        filled += 1
+                        break
+                if filled == m:
+                    return table
+    return table
+
+
+def build_lb(registry_or_services,
+             cfg: Optional[LBConfig] = None) -> LBTables:
+    """Compile LB state. Deterministic given the service set
+    (services/frontends iterated in sorted registry order).
+
+    Accepts a ServiceRegistry (preferred: its stable rev-NAT id allocator is
+    used) or a plain Service sequence (ids fall back to positional — only
+    safe when the service set never changes, e.g. one-shot tests)."""
+    cfg = cfg or LBConfig()
+    if hasattr(registry_or_services, "all"):
+        services: Sequence[Service] = registry_or_services.all()
+        rnat_id_of = registry_or_services.rnat_id
+    else:
+        services = registry_or_services
+        _pos = {}
+        rnat_id_of = lambda fe: _pos.setdefault(  # noqa: E731
+            (fe.addr, fe.port, fe.proto), len(_pos))
+    frontends: List[Frontend] = []
+    fe_names: List[str] = []
+    fe_service: List[int] = []
+    fe_rnat_ids: List[int] = []
+    maglev_rows: List[np.ndarray] = []
+    all_backends: List[Backend] = []
+
+    for svc in services:
+        if not svc.frontends:
+            continue
+        base = len(all_backends)
+        local = list(svc.lb_backends)
+        all_backends.extend(local)
+        row = maglev_table(local, cfg.maglev_m)
+        row = np.where(row >= 0, row + base, -1).astype(np.int32)
+        srow = len(maglev_rows)
+        maglev_rows.append(row)
+        for fe in svc.frontends:
+            frontends.append(fe)
+            fe_names.append(f"{svc.namespace}/{svc.name}")
+            fe_service.append(srow)
+            fe_rnat_ids.append(rnat_id_of(fe))
+
+    F = len(frontends)
+    B = len(all_backends)
+    S = len(maglev_rows)
+    R = max(fe_rnat_ids) + 1 if fe_rnat_ids else 1
+    rnat_addr = np.zeros((R, 4), dtype=np.uint32)
+    rnat_port = np.zeros((R,), dtype=np.int32)
+    rnat_valid = np.zeros((R,), dtype=bool)
+    fe_keys = np.zeros((max(F, 1), FE_KEY_WORDS), dtype=np.uint32)
+    seen_keys = {}
+    for i, fe in enumerate(frontends):
+        addr16, _v6 = parse_addr(fe.addr)
+        fe_keys[i] = _fe_key_words(addr16, fe.port, fe.proto)
+        k = (addr16, fe.port, fe.proto)
+        if k in seen_keys:
+            raise ValueError(
+                f"duplicate service frontend {fe.addr}:{fe.port}/{fe.proto}: "
+                f"declared by both {fe_names[seen_keys[k]]} and {fe_names[i]}")
+        seen_keys[k] = i
+        rid = fe_rnat_ids[i]
+        rnat_addr[rid] = fe_keys[i, :4]
+        rnat_port[rid] = fe.port
+        rnat_valid[rid] = True
+
+    be_addr = np.zeros((max(B, 1), 4), dtype=np.uint32)
+    be_port = np.zeros((max(B, 1),), dtype=np.int32)
+    for i, b in enumerate(all_backends):
+        addr16, _v6 = parse_addr(b.addr)
+        be_addr[i] = np.array(addr_to_words(addr16), dtype=np.uint32)
+        be_port[i] = b.port
+
+    maglev = (np.stack(maglev_rows) if S
+              else np.full((1, cfg.maglev_m), -1, dtype=np.int32))
+
+    # open-addressed frontend table; grow until every key fits in the window
+    cap = 8
+    while cap < 2 * max(F, 1):
+        cap *= 2
+    while True:
+        tab_keys = np.zeros((cap, FE_KEY_WORDS), dtype=np.uint32)
+        tab_val = np.full((cap,), -1, dtype=np.int32)
+        ok = True
+        for i in range(F):
+            base_h = int(hash_words_np(fe_keys[i])[()]) & (cap - 1)
+            for d in range(cfg.probe_depth):
+                s = (base_h + d) & (cap - 1)
+                if tab_val[s] < 0:
+                    tab_keys[s] = fe_keys[i]
+                    tab_val[s] = i
+                    break
+            else:
+                ok = False
+                break
+        if ok:
+            break
+        cap *= 2
+
+    return LBTables(
+        tab_keys=tab_keys, tab_val=tab_val,
+        fe_service=np.asarray(fe_service, dtype=np.int32)
+        if F else np.zeros((1,), dtype=np.int32),
+        fe_rnat_id=np.asarray(fe_rnat_ids, dtype=np.int32)
+        if F else np.zeros((1,), dtype=np.int32),
+        rnat_addr=rnat_addr, rnat_port=rnat_port, rnat_valid=rnat_valid,
+        maglev=maglev, be_addr=be_addr, be_port=be_port,
+        probe_depth=cfg.probe_depth,
+        frontends=tuple(frontends), fe_names=tuple(fe_names),
+        backends=tuple(all_backends),
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Host mirrors (one definition of the semantics — the jnp executor in
+# kernels/lb.py must agree bit-for-bit; test-enforced)
+# --------------------------------------------------------------------------- #
+def lb_select_words_np(batch) -> np.ndarray:
+    """[N, 10] uint32 backend-selection words: the forward CT key with the
+    direction bits masked off. Selection only ever runs on un-translated
+    forward packets (dst = VIP) — replies carry the client address as dst and
+    never match a frontend — so this just has to be deterministic per flow."""
+    src, dst = batch["src"], batch["dst"]
+    return np.stack([
+        src[:, 0], src[:, 1], src[:, 2], src[:, 3],
+        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
+        (batch["sport"].astype(np.uint32) << np.uint32(16))
+        | batch["dport"].astype(np.uint32),
+        batch["proto"].astype(np.uint32) << np.uint32(8),
+    ], axis=-1).astype(np.uint32)
+
+
+def lb_lookup_np(lb: LBTables, batch) -> np.ndarray:
+    """Frontend index per packet (-1 = no service). Mirrors kernels/lb.py."""
+    n = batch["dport"].shape[0]
+    keys = np.stack([
+        batch["dst"][:, 0], batch["dst"][:, 1],
+        batch["dst"][:, 2], batch["dst"][:, 3],
+        batch["dport"].astype(np.uint32), batch["proto"].astype(np.uint32),
+    ], axis=-1).astype(np.uint32)
+    cap = lb.tab_keys.shape[0]
+    base = hash_words_np(keys).astype(np.int64) & (cap - 1)
+    found = np.full((n,), -1, dtype=np.int32)
+    for d in range(lb.probe_depth):
+        s = (base + d) & (cap - 1)
+        eq = (lb.tab_keys[s] == keys).all(axis=-1) & (lb.tab_val[s] >= 0)
+        found = np.where((found < 0) & eq, lb.tab_val[s], found)
+    return found
+
+
+def lb_translate_np(lb: LBTables, batch):
+    """Host mirror of the kernel's LB step → (new_dst, new_dport, rev_nat,
+    no_backend, fe_idx). rev_nat is the frontend's stable rev-NAT id + 1
+    (0 = untranslated)."""
+    fe_idx = lb_lookup_np(lb, batch)
+    hit = (fe_idx >= 0) & np.asarray(batch["valid"])
+    safe_fe = np.where(hit, fe_idx, 0)
+    h = hash_words_np(lb_select_words_np(batch)).astype(np.int64)
+    m = lb.maglev.shape[1]
+    be = lb.maglev[lb.fe_service[safe_fe], h % m]
+    no_backend = hit & (be < 0)
+    do = hit & (be >= 0)
+    safe_be = np.where(do, be, 0)
+    new_dst = np.where(do[:, None], lb.be_addr[safe_be], batch["dst"])
+    new_dport = np.where(do, lb.be_port[safe_be], batch["dport"])
+    rev_nat = np.where(do, lb.fe_rnat_id[safe_fe] + 1, 0).astype(np.int32)
+    return new_dst, new_dport, rev_nat, no_backend, fe_idx

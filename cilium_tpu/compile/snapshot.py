@@ -17,6 +17,7 @@ import numpy as np
 from cilium_tpu.compile.ct_layout import CTConfig
 from cilium_tpu.compile.idclass import IdentityClasses, build_identity_classes
 from cilium_tpu.compile.l7 import L7SetInterner, L7Tensors, build_l7_tensors
+from cilium_tpu.compile.lb import LBConfig, LBTables, build_lb
 from cilium_tpu.compile.lpm import LPMTables, build_lpm
 from cilium_tpu.compile.policy_image import PolicyImage, build_policy_image
 from cilium_tpu.compile.portclass import PortClassTable, build_port_classes
@@ -36,6 +37,7 @@ class PolicySnapshot:
     port_classes: PortClassTable
     lpm: LPMTables
     l7: L7Tensors
+    lb: LBTables
     proto_family_table: np.ndarray           # [256] int32
     world_index: int
     ct_config: CTConfig
@@ -57,6 +59,7 @@ class PolicySnapshot:
             "l7_path": self.l7.path,
             "l7_path_len": self.l7.path_len,
             "l7_valid": self.l7.valid,
+            **self.lb.tensors(),
         }
 
     def static_config(self) -> Dict[str, int]:
@@ -81,7 +84,8 @@ def _proto_family_table() -> np.ndarray:
 
 def build_snapshot(repo: Repository, ctx: PolicyContext,
                    endpoints: Sequence[Endpoint],
-                   ct_config: Optional[CTConfig] = None) -> PolicySnapshot:
+                   ct_config: Optional[CTConfig] = None,
+                   lb_config: Optional[LBConfig] = None) -> PolicySnapshot:
     """Compile the current control-plane state for ``endpoints``.
 
     Mirrors the regeneration pipeline (SURVEY.md §3.2): resolve policy per
@@ -116,6 +120,8 @@ def build_snapshot(repo: Repository, ctx: PolicyContext,
     lpm = build_lpm(ctx.ipcache.snapshot(), id_classes.index_of,
                     default_index=id_classes.index_of[C.IDENTITY_WORLD])
 
+    lb = build_lb(ctx.services, lb_config)  # registry → stable rev-NAT ids
+
     return PolicySnapshot(
         revision=repo.revision,
         ep_ids=ep_ids,
@@ -126,6 +132,7 @@ def build_snapshot(repo: Repository, ctx: PolicyContext,
         port_classes=port_classes,
         lpm=lpm,
         l7=l7_tensors,
+        lb=lb,
         proto_family_table=_proto_family_table(),
         world_index=id_classes.index_of[C.IDENTITY_WORLD],
         ct_config=ct_config or CTConfig(),

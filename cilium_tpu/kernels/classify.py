@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from cilium_tpu.compile.ct_layout import PROBE_DEPTH
 from cilium_tpu.kernels import conntrack as ctk
 from cilium_tpu.kernels.l7 import l7_match_batch
+from cilium_tpu.kernels.lb import lb_step
 from cilium_tpu.kernels.lpm import lpm_lookup_batch
 from cilium_tpu.kernels.policy import policy_lookup_batch
 from cilium_tpu.utils import constants as C
@@ -28,18 +29,40 @@ N_REASON_BINS = 256
 
 def classify_step(tensors, ct, batch, now, world_index=0, *,
                   probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
-                  rule_axis=None):
+                  rule_axis=None, lb_probe_depth: int = 8):
     # ``world_index`` is a traced scalar (not static): it changes whenever the
     # identity table grows, and baking it in would force a re-jit per snapshot.
     # ``rule_axis`` names a mesh axis for rule-space (verdict-row) sharding.
     """→ (out, new_ct, counters).
 
     out: allow [N] bool, reason [N] int32 (DropReason), status [N] int32
-    (CTStatus), remote_identity [N] uint32, redirect [N] bool.
+    (CTStatus), remote_identity [N] uint32, redirect [N] bool, plus the NAT
+    rewrite columns the shim applies: svc [N] bool, nat_dst [N,4] uint32,
+    nat_dport [N] int32 (forward DNAT) and rnat [N] bool, rnat_src [N,4]
+    uint32, rnat_sport [N] int32 (reply un-DNAT).
     counters: by_reason_dir [512] uint32, insert_fail uint32 scalar.
     """
     valid = batch["valid"]
     direction = batch["direction"]
+
+    # 0. service LB (bpf/lib/lb.h analog): frontend match → Maglev backend →
+    # DNAT. Everything downstream (LPM, CT, policy) sees the translated
+    # tuple, exactly like the upstream from-container path (LB before
+    # policy). ``no_backend`` drops below.
+    has_lb = "lb_tab_keys" in tensors
+    if has_lb:
+        new_dst, new_dport, rev_nat, no_backend = lb_step(
+            tensors, batch, probe_depth=lb_probe_depth)
+        svc = rev_nat > 0
+        batch = dict(batch)
+        batch["dst"] = new_dst
+        batch["dport"] = new_dport
+        valid = valid & ~no_backend
+    else:
+        n = valid.shape[0]
+        rev_nat = jnp.zeros((n,), dtype=jnp.int32)
+        svc = jnp.zeros((n,), dtype=bool)
+        no_backend = jnp.zeros((n,), dtype=bool)
 
     # 1. ipcache LPM: remote = dst on egress, src on ingress
     remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
@@ -93,6 +116,7 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
                       jnp.where(l7_fail, int(C.DropReason.POLICY_L7),
                                 int(C.DropReason.OK)))),
     ).astype(jnp.int32)
+    reason = jnp.where(no_backend, int(C.DropReason.NO_SERVICE), reason)
     status = jnp.where(est, int(C.CTStatus.ESTABLISHED),
                        jnp.where(reply, int(C.CTStatus.REPLY),
                                  int(C.CTStatus.NEW))).astype(jnp.int32)
@@ -106,11 +130,34 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     contrib = allow & (jnp.where(hit, True, slot_new >= 0))
     new_ct = ctk.ct_apply(ct, batch, slot, reply, contrib, now,
                           new_keys=new_keys, new_created=new_created,
-                          zero_mask=zero_mask)
+                          zero_mask=zero_mask, rev_nat_vals=rev_nat)
 
-    # 7. counters (metricsmap analog: per reason × direction)
+    # 6b. reply un-DNAT (lb4_rev_nat analog): a reply on a service flow
+    # carries the CT entry's stable rev-NAT id → rewrite src back to the
+    # VIP. Ids whose service is gone resolve to an invalid row → no rewrite
+    # (fail closed; never another service's VIP).
+    if has_lb:
+        slot_safe = jnp.where(hit_slot >= 0, hit_slot, 0)
+        entry_rnat = ct["rev_nat"][slot_safe].astype(jnp.int32)
+        n_rnat = tensors["lb_rnat_valid"].shape[0]
+        rid = entry_rnat - 1
+        known = (rid >= 0) & (rid < n_rnat)
+        rid_safe = jnp.where(known, rid, 0)
+        rnat = reply & known & tensors["lb_rnat_valid"][rid_safe]
+        rnat_src = jnp.where(rnat[:, None], tensors["lb_rnat_addr"][rid_safe],
+                             batch["src"])
+        rnat_sport = jnp.where(rnat, tensors["lb_rnat_port"][rid_safe],
+                               batch["sport"]).astype(jnp.int32)
+    else:
+        rnat = jnp.zeros_like(svc)
+        rnat_src = batch["src"]
+        rnat_sport = batch["sport"].astype(jnp.int32)
+
+    # 7. counters (metricsmap analog: per reason × direction); no_backend
+    # drops count under NO_SERVICE even though they are datapath-invalid
+    counted = valid | no_backend
     bin_idx = reason * 2 + direction
-    scat = jnp.where(valid, bin_idx, N_REASON_BINS * 2)
+    scat = jnp.where(counted, bin_idx, N_REASON_BINS * 2)
     by_reason_dir = jnp.zeros((N_REASON_BINS * 2,), dtype=jnp.uint32).at[scat].add(
         jnp.uint32(1), mode="drop")
     counters = {
@@ -124,6 +171,12 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
         "status": status,
         "remote_identity": remote_identity,
         "redirect": redirect,
+        "svc": svc & valid,
+        "nat_dst": batch["dst"],
+        "nat_dport": batch["dport"].astype(jnp.int32),
+        "rnat": rnat,
+        "rnat_src": rnat_src,
+        "rnat_sport": rnat_sport,
     }
     return out, new_ct, counters
 

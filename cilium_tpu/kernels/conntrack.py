@@ -128,9 +128,13 @@ def ct_insert_new(ct, keys, want_insert, now,
 
 
 def ct_apply(ct, batch, slot, is_reply, contrib, now,
-             new_keys=None, new_created=None, zero_mask=None):
+             new_keys=None, new_created=None, zero_mask=None,
+             rev_nat_vals=None):
     """Aggregate all allowed packets' effects into the table (snapshot
     semantics). ``slot`` [N] (-1 = none), ``contrib`` [N] bool.
+    ``rev_nat_vals`` [N] int32: per-packet rev-NAT id to record for freshly
+    created entries (0 = none; duplicates of one flow carry the same value,
+    so the scatter-max is deterministic).
 
     Returns the new ct pytree.
     """
@@ -140,11 +144,19 @@ def ct_apply(ct, batch, slot, is_reply, contrib, now,
     flags = ct["flags"]
     fwd = ct["pkts_fwd"]
     rev = ct["pkts_rev"]
+    rnat = ct["rev_nat"]
     if zero_mask is not None:
         zero32 = jnp.uint32(0)
         flags = jnp.where(zero_mask, zero32, flags)
         fwd = jnp.where(zero_mask, zero32, fwd)
         rev = jnp.where(zero_mask, zero32, rev)
+        rnat = jnp.where(zero_mask, zero32, rnat)
+    if rev_nat_vals is not None and zero_mask is not None:
+        # only freshly created entries record a rev-NAT id (create-time
+        # semantics, like upstream ct_create4's rev_nat_index)
+        fresh = contrib & (slot >= 0) & zero_mask[jnp.where(slot >= 0, slot, 0)]
+        rnat = rnat.at[jnp.where(fresh, slot, cap)].max(
+            rev_nat_vals.astype(jnp.uint32), mode="drop")
 
     scat = jnp.where(contrib, slot, cap)  # OOB → dropped
     delta = _flag_delta(batch["proto"], batch["tcp_flags"], is_reply)
@@ -174,6 +186,7 @@ def ct_apply(ct, batch, slot, is_reply, contrib, now,
         "flags": flags,
         "pkts_fwd": fwd,
         "pkts_rev": rev,
+        "rev_nat": rnat,
     }
 
 
@@ -189,4 +202,5 @@ def ct_sweep(ct, now):
     new_ct["pkts_fwd"] = jnp.where(dead, zero32, ct["pkts_fwd"])
     new_ct["pkts_rev"] = jnp.where(dead, zero32, ct["pkts_rev"])
     new_ct["created"] = jnp.where(dead, zero32, ct["created"])
+    new_ct["rev_nat"] = jnp.where(dead, zero32, ct["rev_nat"])
     return new_ct, dead.sum()

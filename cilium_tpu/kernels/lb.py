@@ -1,0 +1,63 @@
+"""Device LB step: frontend hash-table lookup → Maglev backend select →
+DNAT rewrite (the ``bpf/lib/lb.h`` analog, jnp executor of the semantics
+defined in compile/lb.py's host mirrors — agreement is test-enforced).
+
+Branch-free: every packet probes the frontend table; misses rewrite nothing.
+Three gathers (table, maglev row, backend) + elementwise selects — XLA fuses
+the selects into the surrounding classify pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cilium_tpu.kernels.hashing import hash_words_jnp
+
+
+def lb_step(tensors, batch, probe_depth: int = 8):
+    """→ (new_dst [N,4], new_dport [N], rev_nat [N], no_backend [N]).
+
+    ``rev_nat`` is the frontend's STABLE rev-NAT id + 1 (0 = untranslated) —
+    ids survive service churn, see compile/lb.LBTables; ``no_backend`` marks
+    packets addressed to a frontend whose service has no backends (dropped
+    with NO_SERVICE by the caller, upstream DROP_NO_SERVICE).
+    """
+    dst = batch["dst"]
+    keys = jnp.stack([
+        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
+        batch["dport"].astype(jnp.uint32), batch["proto"].astype(jnp.uint32),
+    ], axis=-1).astype(jnp.uint32)
+
+    tab_keys = tensors["lb_tab_keys"]
+    tab_val = tensors["lb_tab_val"]
+    cap = tab_keys.shape[0]
+    base = (hash_words_jnp(keys) & jnp.uint32(cap - 1)).astype(jnp.int32)
+    fe_idx = jnp.full(base.shape, -1, dtype=jnp.int32)
+    for d in range(probe_depth):
+        s = (base + d) & (cap - 1)
+        eq = jnp.all(tab_keys[s] == keys, axis=-1) & (tab_val[s] >= 0)
+        fe_idx = jnp.where((fe_idx < 0) & eq, tab_val[s], fe_idx)
+
+    hit = (fe_idx >= 0) & batch["valid"]
+    safe_fe = jnp.where(hit, fe_idx, 0)
+
+    src = batch["src"]
+    sel_words = jnp.stack([
+        src[:, 0], src[:, 1], src[:, 2], src[:, 3],
+        dst[:, 0], dst[:, 1], dst[:, 2], dst[:, 3],
+        (batch["sport"].astype(jnp.uint32) << jnp.uint32(16))
+        | batch["dport"].astype(jnp.uint32),
+        batch["proto"].astype(jnp.uint32) << jnp.uint32(8),
+    ], axis=-1).astype(jnp.uint32)
+    m = tensors["lb_maglev"].shape[1]
+    slot = (hash_words_jnp(sel_words) % jnp.uint32(m)).astype(jnp.int32)
+    be = tensors["lb_maglev"][tensors["lb_fe_service"][safe_fe], slot]
+
+    no_backend = hit & (be < 0)
+    do = hit & (be >= 0)
+    safe_be = jnp.where(do, be, 0)
+    new_dst = jnp.where(do[:, None], tensors["lb_be_addr"][safe_be], dst)
+    new_dport = jnp.where(do, tensors["lb_be_port"][safe_be], batch["dport"])
+    rev_nat = jnp.where(do, tensors["lb_fe_rnat_id"][safe_fe] + 1,
+                        0).astype(jnp.int32)
+    return new_dst, new_dport, rev_nat, no_backend

@@ -1,6 +1,15 @@
-"""Host-side service registry (thin analog of upstream ``pkg/service`` /
-k8s Service watchers), just enough to resolve ``toServices`` rules
-(BASELINE config 3): a service = name/namespace + labels + backend IPs.
+"""Host-side service registry (analog of upstream ``pkg/service`` /
+``pkg/loadbalancer`` + the k8s Service watchers).
+
+Two roles:
+- resolve ``toServices`` rules (BASELINE config 3) via service labels →
+  backend IPs;
+- describe load-balancer state (frontends → backends) that
+  ``compile/lb.py`` turns into the device service/Maglev/rev-NAT tensors
+  (the lbmap analog, SURVEY.md §2 "Services/LB").
+
+A frontend is a (VIP, port, proto) the datapath DNATs (ClusterIP,
+NodePort on a node IP, ExternalIP). Backends are (ip, port, weight).
 """
 
 from __future__ import annotations
@@ -12,13 +21,51 @@ from typing import Callable, Dict, List, Tuple
 from cilium_tpu.model.labels import Labels
 from cilium_tpu.model.selectors import EndpointSelector
 
+SVC_CLUSTER_IP = "ClusterIP"
+SVC_NODEPORT = "NodePort"
+SVC_EXTERNAL_IP = "ExternalIP"
+SVC_LOADBALANCER = "LoadBalancer"
+
+
+@dataclass(frozen=True)
+class Frontend:
+    """One DNAT'able service address: VIP:port/proto."""
+    addr: str                          # v4 or v6 literal
+    port: int
+    proto: int = 6                     # IP protocol number (TCP)
+    kind: str = SVC_CLUSTER_IP
+
+    def __post_init__(self):
+        if not (0 < self.port < 65536):
+            raise ValueError(f"bad frontend port {self.port}")
+
+
+@dataclass(frozen=True)
+class Backend:
+    addr: str
+    port: int
+    weight: int = 1                    # Maglev weighting (upstream lb.h)
+
+    def __post_init__(self):
+        if self.weight < 1:
+            raise ValueError("backend weight must be >= 1")
+
 
 @dataclass(frozen=True)
 class Service:
     name: str
     namespace: str
-    backends: Tuple[str, ...]          # backend IPs (pod or external)
+    backends: Tuple[str, ...] = ()     # backend IPs for toServices expansion
     extra_labels: Tuple[Tuple[str, str], ...] = ()
+    # Load-balancer state (empty for headless/selector-only services):
+    frontends: Tuple[Frontend, ...] = ()
+    lb_backends: Tuple[Backend, ...] = ()
+
+    @property
+    def backend_ips(self) -> Tuple[str, ...]:
+        """IPs used for toServices rule expansion: explicit ``backends``
+        else the LB backend addresses."""
+        return self.backends or tuple(b.addr for b in self.lb_backends)
 
     @property
     def labels(self) -> Labels:
@@ -35,12 +82,51 @@ class ServiceRegistry:
         self._lock = threading.RLock()
         self._services: Dict[Tuple[str, str], Service] = {}
         self._observers: List[Callable[[], None]] = []
+        # Stable rev-NAT id per frontend (addr16, port, proto) — the analog
+        # of upstream's allocated RevNatID: ids survive service churn so
+        # long-lived CT entries never resolve to the wrong VIP. Ids are
+        # never reused within a registry lifetime (stale CT entries could
+        # otherwise rewrite replies to a NEW service's VIP).
+        self._rnat_ids: Dict[Tuple[bytes, int, int], int] = {}
+        self._next_rnat_id = 0
 
     def add_observer(self, obs: Callable[[], None]) -> None:
         self._observers.append(obs)
 
+    def rnat_id(self, fe: Frontend) -> int:
+        from cilium_tpu.utils.ip import parse_addr
+        key = (parse_addr(fe.addr)[0], fe.port, fe.proto)
+        with self._lock:
+            rid = self._rnat_ids.get(key)
+            if rid is None:
+                rid = self._next_rnat_id
+                self._next_rnat_id += 1
+                self._rnat_ids[key] = rid
+            return rid
+
+    def export_rnat_state(self) -> Dict:
+        from cilium_tpu.utils.ip import addr_to_str
+        with self._lock:
+            return {
+                "next_id": self._next_rnat_id,
+                "ids": [{"addr": addr_to_str(a), "port": p, "proto": pr,
+                         "id": rid}
+                        for (a, p, pr), rid in sorted(self._rnat_ids.items(),
+                                                      key=lambda kv: kv[1])],
+            }
+
+    def restore_rnat_state(self, state: Dict) -> None:
+        from cilium_tpu.utils.ip import parse_addr
+        with self._lock:
+            self._next_rnat_id = state["next_id"]
+            self._rnat_ids = {
+                (parse_addr(e["addr"])[0], e["port"], e["proto"]): e["id"]
+                for e in state["ids"]}
+
     def upsert(self, svc: Service) -> None:
         with self._lock:
+            for fe in svc.frontends:
+                self.rnat_id(fe)      # allocate eagerly, deterministically
             self._services[(svc.namespace, svc.name)] = svc
         for obs in list(self._observers):
             obs()

@@ -43,16 +43,28 @@ def make_mesh(n_flow_shards: int, n_rule_shards: int = 1, devices=None):
 # --------------------------------------------------------------------------- #
 # Host-side steering (the RSS analog; the C++ shim implements the same hash)
 # --------------------------------------------------------------------------- #
-def flow_shard_of(batch: BatchArrays, n_shards: int) -> np.ndarray:
+def flow_shard_of(batch: BatchArrays, n_shards: int,
+                  lb=None) -> np.ndarray:
     """Direction-normalized shard index per packet: XOR of forward and
-    reverse key hashes is symmetric, so both directions of a flow agree."""
+    reverse key hashes is symmetric, so both directions of a flow agree.
+
+    ``lb`` (a compiled compile/lb.LBTables) translates service VIPs first —
+    CT entries live under the DNAT'ed tuple, so steering must hash the
+    translated tuple or a service flow's forward and reply packets would
+    land on different CT shards. The C++ shim runs the same translation."""
+    if lb is not None and lb.n_frontends:
+        from cilium_tpu.compile.lb import lb_translate_np
+        new_dst, new_dport, _rnat, _nb, _fe = lb_translate_np(lb, batch)
+        batch = dict(batch)
+        batch["dst"] = new_dst
+        batch["dport"] = new_dport
     h = hash_words_np(ct_key_words(batch, reverse=False)) \
         ^ hash_words_np(ct_key_words(batch, reverse=True))
     return (h % np.uint32(n_shards)).astype(np.int32)
 
 
 def steer_batch(batch: BatchArrays, n_shards: int,
-                per_shard: Optional[int] = None
+                per_shard: Optional[int] = None, lb=None
                 ) -> Tuple[BatchArrays, np.ndarray, int]:
     """Regroup a batch so packets of shard s occupy rows
     [s*per_shard, (s+1)*per_shard) (invalid-padded).
@@ -61,7 +73,7 @@ def steer_batch(batch: BatchArrays, n_shards: int,
     ``scatter_index[i]`` is the steered row of original packet i — use it to
     gather per-packet outputs back into original order."""
     n = batch["valid"].shape[0]
-    shard = flow_shard_of(batch, n_shards)
+    shard = flow_shard_of(batch, n_shards, lb=lb)
     shard = np.where(np.asarray(batch["valid"]), shard, n_shards - 1)
     counts = np.bincount(shard, weights=np.asarray(batch["valid"]).astype(np.int64),
                          minlength=n_shards).astype(np.int64)
@@ -171,15 +183,23 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
         "enforced": P(), "id_class_of": P(), "identity_ids": P(),
         "lpm_v4": P(), "lpm_v6": P(), "port_class": P(), "proto_family": P(),
         "l7_methods": P(), "l7_path": P(), "l7_path_len": P(), "l7_valid": P(),
+        # LB state is replicated: small, read-only, gathered per packet
+        "lb_tab_keys": P(), "lb_tab_val": P(), "lb_fe_service": P(),
+        "lb_fe_rnat_id": P(), "lb_rnat_addr": P(), "lb_rnat_port": P(),
+        "lb_rnat_valid": P(), "lb_maglev": P(),
+        "lb_be_addr": P(), "lb_be_port": P(),
     }
     ct_spec = {k: P("flows") for k in
-               ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev")}
+               ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev",
+                "rev_nat")}
     batch_spec = {k: P("flows") for k in
                   ("src", "dst", "sport", "dport", "proto", "tcp_flags",
                    "is_v6", "ep_slot", "direction", "http_method",
                    "http_path", "valid")}
     out_spec = {k: P("flows") for k in
-                ("allow", "reason", "status", "remote_identity", "redirect")}
+                ("allow", "reason", "status", "remote_identity", "redirect",
+                 "svc", "nat_dst", "nat_dport", "rnat", "rnat_src",
+                 "rnat_sport")}
     counters_spec = {"by_reason_dir": P(), "insert_fail": P()}
 
     fn = shard_map(

@@ -199,7 +199,7 @@ class Repository:
             # treat each backend as a host-prefix CIDR peer (upstream resolves
             # ToServices through the service cache into selector identities).
             for svc in ctx.services.match(svc_sel):
-                for backend_ip in svc.backends:
+                for backend_ip in svc.backend_ips:
                     prefix = normalize_prefix(
                         f"{backend_ip}/128" if ":" in backend_ip
                         else f"{backend_ip}/32")

@@ -22,7 +22,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from cilium_tpu.model.services import Service
+from cilium_tpu.model.services import Backend, Frontend, Service
 from cilium_tpu.runtime.engine import Engine
 
 STATE_FILE = "state.json"
@@ -45,9 +45,17 @@ def save(engine: Engine, path: str) -> None:
         "rules": [r.raw for r in engine.repo.all_rules() if r.raw is not None],
         "services": [
             {"name": s.name, "namespace": s.namespace,
-             "backends": list(s.backends)}
+             "backends": list(s.backends),
+             "frontends": [{"addr": f.addr, "port": f.port,
+                            "proto": f.proto, "kind": f.kind}
+                           for f in s.frontends],
+             "lb_backends": [{"addr": b.addr, "port": b.port,
+                              "weight": b.weight} for b in s.lb_backends]}
             for s in engine.ctx.services.all()
         ],
+        # stable rev-NAT ids must survive restarts: restored CT entries
+        # reference them
+        "rnat_state": engine.ctx.services.export_rnat_state(),
     }
     # write-then-rename so a crash never leaves a torn checkpoint
     fd, tmp = tempfile.mkstemp(dir=path, prefix=".state-")
@@ -75,10 +83,16 @@ def restore(engine: Engine, path: str) -> None:
     # identity numbering must be restored FIRST so that endpoint/CIDR
     # allocation below resolves to the same ids (idempotent via label lookup)
     engine.ctx.allocator.restore_state(state["identity_state"])
+    if "rnat_state" in state:
+        engine.ctx.services.restore_rnat_state(state["rnat_state"])
     for svc in state.get("services", []):
         engine.ctx.services.upsert(Service(
             name=svc["name"], namespace=svc["namespace"],
-            backends=tuple(svc["backends"])))
+            backends=tuple(svc["backends"]),
+            frontends=tuple(Frontend(**f)
+                            for f in svc.get("frontends", [])),
+            lb_backends=tuple(Backend(**b)
+                              for b in svc.get("lb_backends", []))))
     for ep in state["endpoints"]:
         engine.add_endpoint(ep["labels"], ep["ips"], ep_id=ep["ep_id"],
                             enforcement=ep.get("enforcement"))

@@ -27,6 +27,7 @@ class DaemonConfig:
     probe_depth: int = 8
     batch_size: int = 8192
     v4_only: bool = False
+    maglev_m: int = 251            # Maglev table size (prime; prod: 16381)
     # --- device/runtime ---
     device: str = "auto"           # auto | cpu | tpu
     n_shards: int = 1              # data-parallel flow shards (mesh size)

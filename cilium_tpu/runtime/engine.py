@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.lb import LBConfig
 from cilium_tpu.compile.snapshot import PolicySnapshot, build_snapshot
 from cilium_tpu.kernels.classify import make_classify_fn
 from cilium_tpu.kernels import conntrack as ctk
@@ -91,6 +92,9 @@ class Engine:
                                       sync=not self.config.auto_regen)
         self.repo.add_observer(lambda rev: self._regen_trigger())
         self.ctx.ipcache.add_observer(self._mark_dirty)
+        # LB-only service changes (no toServices rule referencing them) still
+        # need a recompile: the frontend/Maglev tensors live in the snapshot
+        self.ctx.services.add_observer(self._mark_dirty)
 
     # -- backend selection ----------------------------------------------------
     def _select_backend(self) -> None:
@@ -133,6 +137,15 @@ class Engine:
             self._mark_dirty()
             return True
 
+    # -- services (pkg/service analog) -----------------------------------------
+    def upsert_service(self, svc) -> None:
+        """Add/replace a Service (frontends+backends program the LB tensors
+        at the next regeneration; upstream: service upsert → lbmap writes)."""
+        self.ctx.services.upsert(svc)
+
+    def delete_service(self, namespace: str, name: str) -> bool:
+        return self.ctx.services.delete(namespace, name)
+
     # -- policy ----------------------------------------------------------------
     def apply_policy(self, docs) -> int:
         """Ingest CNP-style rule documents (list/dict/JSON string)."""
@@ -165,7 +178,8 @@ class Engine:
                 snap = build_snapshot(
                     self.repo, self.ctx,
                     sorted(self.endpoints.values(), key=lambda e: e.ep_id),
-                    CTConfig(self.config.ct_capacity, self.config.probe_depth))
+                    CTConfig(self.config.ct_capacity, self.config.probe_depth),
+                    LBConfig(maglev_m=self.config.maglev_m))
             with self.metrics.span("device_place").timer():
                 tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
             compiled = CompiledSnapshot(
@@ -245,6 +259,10 @@ class Engine:
     def load_ct_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
         jnp = self._jnp
         expected = set(self._ct.keys())
+        if "rev_nat" not in arrays and "expiry" in arrays:
+            # checkpoints written before the service rev-NAT column
+            arrays = dict(arrays)
+            arrays["rev_nat"] = np.zeros_like(arrays["expiry"])
         if set(arrays.keys()) != expected:
             raise ValueError(f"CT arrays mismatch: {sorted(arrays)} != "
                              f"{sorted(expected)}")

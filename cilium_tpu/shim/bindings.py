@@ -80,9 +80,23 @@ def _load_lib():
     lib.shim_get_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ShimStats)]
     lib.shim_flow_shard.restype = ctypes.c_uint32
     lib.shim_flow_shard.argtypes = [ctypes.POINTER(ShimRecord), ctypes.c_uint32]
+    lib.shim_flow_shard2.restype = ctypes.c_uint32
+    lib.shim_flow_shard2.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ShimRecord), ctypes.c_uint32]
+    lib.shim_set_lb.restype = ctypes.c_int
+    lib.shim_set_lb.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_uint32]
     lib.shim_afxdp_bind.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
     return lib
+
+
 
 
 class FlowShim:
@@ -150,9 +164,33 @@ class FlowShim:
         self._lib.shim_get_stats(self._handle, ctypes.byref(s))
         return {n: getattr(s, n) for n, _ in ShimStats._fields_}
 
+    def set_lb(self, lb) -> None:
+        """Program the steering-side LB state from a compile/lb.LBTables.
+        Steering then matches parallel/mesh.flow_shard_of(batch, n, lb=lb):
+        service DNAT first, so forward and reply packets of a service flow
+        land on the same CT shard."""
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        pu32 = ctypes.POINTER(ctypes.c_uint32)
+        # keep contiguous copies alive across the call (C++ copies them)
+        tk = np.ascontiguousarray(lb.tab_keys, dtype=np.uint32)
+        tv = np.ascontiguousarray(lb.tab_val, dtype=np.int32)
+        fs = np.ascontiguousarray(lb.fe_service, dtype=np.int32)
+        mg = np.ascontiguousarray(lb.maglev, dtype=np.int32)
+        ba = np.ascontiguousarray(lb.be_addr, dtype=np.uint32)
+        bp = np.ascontiguousarray(lb.be_port, dtype=np.int32)
+        rc = self._lib.shim_set_lb(
+            self._handle,
+            tk.ctypes.data_as(pu32), tv.ctypes.data_as(p32),
+            tk.shape[0], lb.probe_depth,
+            fs.ctypes.data_as(p32), fs.shape[0],
+            mg.ctypes.data_as(p32), mg.shape[0], mg.shape[1],
+            ba.ctypes.data_as(pu32), bp.ctypes.data_as(p32), bp.shape[0])
+        if rc != 0:
+            raise ValueError(f"shim_set_lb failed: {rc}")
+
     def flow_shard(self, rec_index: int, n_shards: int) -> int:
-        return self._lib.shim_flow_shard(
-            ctypes.byref(self._rec_buf[rec_index]), n_shards)
+        return self._lib.shim_flow_shard2(
+            self._handle, ctypes.byref(self._rec_buf[rec_index]), n_shards)
 
     def afxdp_bind(self, ifname: str, queue: int = 0) -> int:
         return self._lib.shim_afxdp_bind(self._handle, ifname.encode(), queue)

@@ -91,6 +91,16 @@ struct Shim {
   std::vector<std::pair<std::array<uint8_t, 16>, uint32_t>> endpoints;
   ShimStats stats{};
   uint32_t next_frame_idx = 0;
+  // service LB steering state (see shim_set_lb)
+  std::vector<uint32_t> lb_tab_keys;  // [cap*6]
+  std::vector<int32_t> lb_tab_val;    // [cap]
+  uint32_t lb_cap = 0;
+  uint32_t lb_probe_depth = 0;
+  std::vector<int32_t> lb_fe_service;  // [F]
+  std::vector<int32_t> lb_maglev;      // [S*M]
+  uint32_t lb_maglev_m = 0;
+  std::vector<uint32_t> lb_be_addr;    // [B*4]
+  std::vector<int32_t> lb_be_port;     // [B]
 #if FLOWSHIM_HAVE_AFXDP
   int xsk_fd = -1;
   void* umem_area = nullptr;
@@ -292,6 +302,76 @@ uint32_t shim_flow_shard(const ShimRecord* rec, uint32_t n_shards) {
   ct_key_words(*rec, false, fwd);
   ct_key_words(*rec, true, rev);
   return (hash_words(fwd, 10) ^ hash_words(rev, 10)) % n_shards;
+}
+
+int shim_set_lb(Shim* s, const uint32_t* tab_keys, const int32_t* tab_val,
+                uint32_t cap, uint32_t probe_depth, const int32_t* fe_service,
+                uint32_t n_fe, const int32_t* maglev, uint32_t n_svc,
+                uint32_t maglev_m, const uint32_t* be_addr,
+                const int32_t* be_port, uint32_t n_be) {
+  if (cap == 0) {
+    s->lb_cap = 0;
+    return 0;
+  }
+  if (cap & (cap - 1)) return -1;  // capacity must be a power of two
+  s->lb_tab_keys.assign(tab_keys, tab_keys + size_t(cap) * 6);
+  s->lb_tab_val.assign(tab_val, tab_val + cap);
+  s->lb_cap = cap;
+  s->lb_probe_depth = probe_depth;
+  s->lb_fe_service.assign(fe_service, fe_service + n_fe);
+  s->lb_maglev.assign(maglev, maglev + size_t(n_svc) * maglev_m);
+  s->lb_maglev_m = maglev_m;
+  s->lb_be_addr.assign(be_addr, be_addr + size_t(n_be) * 4);
+  s->lb_be_port.assign(be_port, be_port + n_be);
+  return 0;
+}
+
+// Mirror of compile/lb.lb_translate_np for one record: frontend probe →
+// Maglev backend select → DNAT of (dst, dport). no-backend frontends stay
+// untranslated (those packets drop; any shard is correct and this matches
+// the host mirror).
+static bool lb_translate(const Shim* s, const ShimRecord& r,
+                         uint32_t new_dst[4], uint16_t* new_dport) {
+  if (s->lb_cap == 0) return false;
+  uint32_t dst[4];
+  memcpy(dst, r.dst, 16);
+  uint32_t key[6] = {dst[0], dst[1], dst[2], dst[3], uint32_t(r.dport),
+                     uint32_t(r.proto)};
+  uint32_t mask = s->lb_cap - 1;
+  uint32_t base = hash_words(key, 6) & mask;
+  int32_t fe = -1;
+  for (uint32_t d = 0; d < s->lb_probe_depth && fe < 0; d++) {
+    uint32_t slot = (base + d) & mask;
+    if (s->lb_tab_val[slot] < 0) continue;
+    if (memcmp(&s->lb_tab_keys[size_t(slot) * 6], key, 24) == 0)
+      fe = s->lb_tab_val[slot];
+  }
+  if (fe < 0) return false;
+  uint32_t src[4];
+  memcpy(src, r.src, 16);
+  uint32_t sel[10] = {src[0], src[1], src[2], src[3],
+                      dst[0], dst[1], dst[2], dst[3],
+                      (uint32_t(r.sport) << 16) | uint32_t(r.dport),
+                      uint32_t(r.proto) << 8};
+  uint32_t slot = hash_words(sel, 10) % s->lb_maglev_m;
+  int32_t be =
+      s->lb_maglev[size_t(s->lb_fe_service[fe]) * s->lb_maglev_m + slot];
+  if (be < 0) return false;
+  memcpy(new_dst, &s->lb_be_addr[size_t(be) * 4], 16);
+  *new_dport = uint16_t(s->lb_be_port[be]);
+  return true;
+}
+
+uint32_t shim_flow_shard2(const Shim* s, const ShimRecord* rec,
+                          uint32_t n_shards) {
+  ShimRecord r = *rec;
+  uint32_t new_dst[4];
+  uint16_t new_dport;
+  if (lb_translate(s, r, new_dst, &new_dport)) {
+    memcpy(r.dst, new_dst, 16);
+    r.dport = new_dport;
+  }
+  return shim_flow_shard(&r, n_shards);
 }
 
 // ---------------------------------------------------------------------------

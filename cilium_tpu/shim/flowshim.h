@@ -111,8 +111,29 @@ int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id);
 // Drain up to ``budget`` frames from the AF_XDP rx ring into the batcher.
 int shim_afxdp_poll(Shim* s, uint32_t budget, uint64_t now_us);
 
+// ---------------------------------------------------------------------------
+// Service LB steering state (mirror of compile/lb.py's frontend hash table +
+// Maglev + backend arrays). Steering must hash the TRANSLATED tuple: CT
+// entries live under the DNAT'ed 5-tuple, so a service flow's forward and
+// reply packets only land on the same CT shard if the shim applies the same
+// deterministic translation the device kernel does.
+// All arrays are copied; row-major. Pass cap=0 to clear.
+// ---------------------------------------------------------------------------
+int shim_set_lb(Shim* s, const uint32_t* tab_keys /*[cap*6]*/,
+                const int32_t* tab_val /*[cap]*/, uint32_t cap,
+                uint32_t probe_depth, const int32_t* fe_service /*[F]*/,
+                uint32_t n_fe, const int32_t* maglev /*[S*M]*/, uint32_t n_svc,
+                uint32_t maglev_m, const uint32_t* be_addr /*[B*4]*/,
+                const int32_t* be_port /*[B]*/, uint32_t n_be);
+
 // RSS-style flow-shard steering (must match
-// cilium_tpu/parallel/mesh.flow_shard_of: XOR of fwd/rev murmur key hashes).
+// cilium_tpu/parallel/mesh.flow_shard_of with the shim's LB state: service
+// DNAT first, then XOR of fwd/rev murmur key hashes).
+uint32_t shim_flow_shard2(const Shim* s, const ShimRecord* rec,
+                          uint32_t n_shards);
+
+// Legacy steering without LB translation (wrong for service traffic on a
+// sharded mesh — kept for non-LB deployments).
 uint32_t shim_flow_shard(const ShimRecord* rec, uint32_t n_shards);
 
 #ifdef __cplusplus

@@ -136,6 +136,7 @@ class DropReason(enum.IntEnum):
     CT_INVALID = 134          # malformed / untrackable (e.g. bad header record)
     INVALID_IDENTITY = 135    # ipcache produced no usable identity
     UNSUPPORTED_PROTO = 136
+    NO_SERVICE = 140          # dst matched a service frontend with no backends
 
 
 # --------------------------------------------------------------------------- #

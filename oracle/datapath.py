@@ -75,6 +75,14 @@ class Verdict:
     remote_identity: int
     redirect: bool = False          # went through L7-lite matching
     matched_key: Optional[object] = None  # MapStateKey for trace
+    # service LB rewrites (bpf/lib/lb.h analog): forward DNAT applied before
+    # classification; reply un-DNAT from the CT entry's rev-NAT id
+    svc: bool = False
+    nat_dst: bytes = b""            # translated dst (16B) when svc
+    nat_dport: int = 0
+    rnat: bool = False
+    rnat_src: bytes = b""           # VIP to restore as reply src
+    rnat_sport: int = 0
 
 
 # --------------------------------------------------------------------------- #
@@ -90,6 +98,7 @@ class CTEntry:
     flags: int = 0                  # CT_FLAG_*
     pkts_fwd: int = 0
     pkts_rev: int = 0
+    rev_nat: int = 0                # frontend idx + 1 (0 = no service DNAT)
 
 
 def _tcp_lifetime(flags: int) -> int:
@@ -158,7 +167,7 @@ class ConntrackTable:
         else:
             e.pkts_fwd += 1
 
-    def create(self, p: PacketRecord, now: int) -> CTKey:
+    def create(self, p: PacketRecord, now: int, rev_nat: int = 0) -> CTKey:
         key = self.fwd_key(p)
         flags = _flag_delta(p.proto, p.tcp_flags, is_reply=False)
         self.entries[key] = CTEntry(
@@ -166,6 +175,7 @@ class ConntrackTable:
             created=now,
             flags=flags,
             pkts_fwd=1,
+            rev_nat=rev_nat,
         )
         return key
 
@@ -200,10 +210,65 @@ def l7_match(http_rules, method: int, path: bytes) -> bool:
 class Oracle:
     def __init__(self, policies: Dict[int, EndpointPolicy],
                  ipcache_entries: Dict[str, int],
-                 ct: Optional[ConntrackTable] = None):
+                 ct: Optional[ConntrackTable] = None,
+                 lb=None):
         self.policies = policies
         self.ipcache_entries = dict(ipcache_entries)
         self.ct = ct if ct is not None else ConntrackTable()
+        # Service LB state: a compiled compile/lb.LBTables (control-plane
+        # input, like the policy snapshot). None = no services.
+        self.lb = lb
+        self._frontends: Dict[Tuple[bytes, int, int], int] = {}
+        if lb is not None:
+            from cilium_tpu.utils.ip import parse_addr
+            for i, fe in enumerate(lb.frontends):
+                addr16, _ = parse_addr(fe.addr)
+                self._frontends[(addr16, fe.port, fe.proto)] = i
+
+    # -- service LB (mirrors kernels/lb.py; bpf/lib/lb.h analog) ------------
+    def _translate(self, p: PacketRecord
+                   ) -> Tuple[PacketRecord, int, bool]:
+        """→ (possibly-DNAT'ed packet, rev_nat value (stable id + 1),
+        no_backend)."""
+        if self.lb is None:
+            return p, 0, False
+        fe_idx = self._frontends.get((p.dst_addr, p.dst_port, p.proto))
+        if fe_idx is None:
+            return p, 0, False
+        import numpy as np
+        from cilium_tpu.compile.lb import lb_select_words_np
+        from cilium_tpu.kernels.hashing import hash_words_np
+        from cilium_tpu.utils.ip import addr_to_words, words_to_addr
+        batch1 = {
+            "src": np.array([addr_to_words(p.src_addr)], dtype=np.uint32),
+            "dst": np.array([addr_to_words(p.dst_addr)], dtype=np.uint32),
+            "sport": np.array([p.src_port], dtype=np.int32),
+            "dport": np.array([p.dst_port], dtype=np.int32),
+            "proto": np.array([p.proto], dtype=np.int32),
+        }
+        m = self.lb.maglev.shape[1]
+        slot = int(hash_words_np(lb_select_words_np(batch1))[0]) % m
+        be = int(self.lb.maglev[int(self.lb.fe_service[fe_idx]), slot])
+        if be < 0:
+            return p, 0, True
+        new_dst = words_to_addr(self.lb.be_addr[be])
+        p2 = replace(p, dst_addr=new_dst, dst_port=int(self.lb.be_port[be]))
+        return p2, int(self.lb.fe_rnat_id[fe_idx]) + 1, False
+
+    def _rnat_fields(self, entry: Optional[CTEntry], p: PacketRecord) -> Dict:
+        """Reply un-DNAT output fields from a hit CT entry. Stale ids whose
+        service is gone resolve to an invalid row → no rewrite."""
+        if entry is None or entry.rev_nat == 0 or self.lb is None:
+            return {}
+        from cilium_tpu.utils.ip import words_to_addr
+        rid = entry.rev_nat - 1
+        if rid >= self.lb.rnat_valid.shape[0] or not self.lb.rnat_valid[rid]:
+            return {}
+        return {
+            "rnat": True,
+            "rnat_src": words_to_addr(self.lb.rnat_addr[rid]),
+            "rnat_sport": int(self.lb.rnat_port[rid]),
+        }
 
     # -- helpers ------------------------------------------------------------
     def _remote_identity(self, p: PacketRecord) -> int:
@@ -264,16 +329,28 @@ class Oracle:
 
     # -- sequential (true eBPF per-packet semantics) ------------------------
     def classify(self, p: PacketRecord, now: int) -> Verdict:
-        remote_id = self._remote_identity(p)
-        status, hit_key = self.ct.probe(p, now)
-        verdict, create = self._verdict_for(p, remote_id, status)
+        tp, rev_nat, no_backend = self._translate(p)
+        if no_backend:
+            # kernel mirror: the packet is masked out of the datapath, so
+            # its CT status reads NEW; remote identity from the VIP itself
+            return Verdict(False, C.DropReason.NO_SERVICE, C.CTStatus.NEW,
+                           self._remote_identity(p))
+        remote_id = self._remote_identity(tp)
+        status, hit_key = self.ct.probe(tp, now)
+        verdict, create = self._verdict_for(tp, remote_id, status)
+        extra: Dict = {}
+        if rev_nat:
+            extra.update(svc=True, nat_dst=tp.dst_addr,
+                         nat_dport=tp.dst_port)
+        if status == C.CTStatus.REPLY:
+            extra.update(self._rnat_fields(self.ct.entries.get(hit_key), tp))
         if status != C.CTStatus.NEW:
             if verdict.allow:
-                self.ct.update(hit_key, p,
+                self.ct.update(hit_key, tp,
                                is_reply=(status == C.CTStatus.REPLY), now=now)
         elif create:
-            self.ct.create(p, now)
-        return verdict
+            self.ct.create(tp, now, rev_nat=rev_nat)
+        return replace(verdict, **extra) if extra else verdict
 
     def classify_batch_sequential(self, packets: List[PacketRecord],
                                   now: int) -> List[Verdict]:
@@ -285,12 +362,30 @@ class Oracle:
         # Phase 1: all verdicts against the CT snapshot at batch start.
         verdicts: List[Verdict] = []
         probes: List[Tuple[int, Optional[CTKey]]] = []
+        tps: List[PacketRecord] = []
+        rev_nats: List[int] = []
         for p in packets:
-            remote_id = self._remote_identity(p)
-            status, hit_key = self.ct.probe(p, now)
+            tp, rev_nat, no_backend = self._translate(p)
+            tps.append(tp)
+            rev_nats.append(rev_nat)
+            if no_backend:
+                verdicts.append(Verdict(False, C.DropReason.NO_SERVICE,
+                                        C.CTStatus.NEW,
+                                        self._remote_identity(p)))
+                probes.append((C.CTStatus.NEW, None))
+                continue
+            remote_id = self._remote_identity(tp)
+            status, hit_key = self.ct.probe(tp, now)
             probes.append((status, hit_key))
-            verdict, _create = self._verdict_for(p, remote_id, status)
-            verdicts.append(verdict)
+            verdict, _create = self._verdict_for(tp, remote_id, status)
+            extra: Dict = {}
+            if rev_nat:
+                extra.update(svc=True, nat_dst=tp.dst_addr,
+                             nat_dport=tp.dst_port)
+            if status == C.CTStatus.REPLY:
+                extra.update(self._rnat_fields(self.ct.entries.get(hit_key),
+                                               tp))
+            verdicts.append(replace(verdict, **extra) if extra else verdict)
 
         # Phase 2: order-independent aggregate CT effects.
         #   For each touched key: flags |= OR of deltas; counters += sums;
@@ -300,9 +395,11 @@ class Oracle:
         def touch(key: CTKey):
             return agg.setdefault(key, {
                 "flag_delta": 0, "fwd": 0, "rev": 0, "create": False,
+                "rev_nat": 0,
             })
 
-        for p, v, (status, hit_key) in zip(packets, verdicts, probes):
+        for p, rev_nat, v, (status, hit_key) in zip(tps, rev_nats, verdicts,
+                                                    probes):
             if not v.allow:
                 continue
             if status == C.CTStatus.ESTABLISHED:
@@ -318,6 +415,7 @@ class Oracle:
                 a["flag_delta"] |= _flag_delta(p.proto, p.tcp_flags, False)
                 a["fwd"] += 1
                 a["create"] = True
+                a["rev_nat"] = max(a["rev_nat"], rev_nat)
 
         for key, a in agg.items():
             entry = self.ct.entries.get(key)
@@ -326,7 +424,8 @@ class Oracle:
             if entry is None:
                 if not a["create"]:
                     continue
-                entry = CTEntry(expiry=0, created=now)
+                entry = CTEntry(expiry=0, created=now,
+                                rev_nat=a["rev_nat"])
                 self.ct.entries[key] = entry
             proto = key[4]
             entry.flags |= a["flag_delta"]

@@ -1,0 +1,380 @@
+"""Service load balancing: Maglev properties, host/device lookup agreement,
+and end-to-end LB parity (DNAT, rev-NAT via CT, no-backend drops, policy on
+the translated tuple) vs the oracle — the lbmap / bpf/lib/lb.h analog."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.lb import (
+    LBConfig, build_lb, lb_lookup_np, lb_translate_np, maglev_table,
+)
+from cilium_tpu.compile.snapshot import build_snapshot
+from cilium_tpu.kernels.classify import classify_step
+from cilium_tpu.kernels.lb import lb_step
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.model.endpoint import Endpoint
+from cilium_tpu.model.identity import IdentityAllocator
+from cilium_tpu.model.ipcache import IPCache
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.rules import parse_rules
+from cilium_tpu.model.services import Backend, Frontend, Service
+from cilium_tpu.policy import PolicyContext, Repository
+from cilium_tpu.policy.selectorcache import SelectorCache
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr, words_to_addr
+from oracle import Oracle, PacketRecord
+from tests.test_parity import extract_device_ct, oracle_live_ct
+
+
+# --------------------------------------------------------------------------- #
+# Maglev
+# --------------------------------------------------------------------------- #
+class TestMaglev:
+    def test_full_and_balanced(self):
+        backends = [Backend(f"10.0.0.{i}", 8080) for i in range(1, 11)]
+        t = maglev_table(backends, 251)
+        assert (t >= 0).all()
+        counts = np.bincount(t, minlength=10)
+        # Maglev guarantees near-perfect balance: max/min <= 2 is loose
+        assert counts.min() > 0
+        assert counts.max() / counts.min() <= 2.0
+
+    def test_empty(self):
+        assert (maglev_table([], 251) == -1).all()
+
+    def test_m_must_be_prime(self):
+        with pytest.raises(ValueError):
+            maglev_table([Backend("10.0.0.1", 80)], 250)
+
+    def test_minimal_disruption(self):
+        backends = [Backend(f"10.0.0.{i}", 8080) for i in range(1, 11)]
+        t1 = maglev_table(backends, 251)
+        t2 = maglev_table(backends[:-1], 251)  # remove one backend
+        moved = (t1 != t2) & (t1 != 9)          # slots not owned by removed
+        # consistent hashing: only ~1/B of non-removed slots re-steer
+        assert moved.sum() / 251 < 0.35
+
+    def test_weighted(self):
+        backends = [Backend("10.0.0.1", 80, weight=3),
+                    Backend("10.0.0.2", 80, weight=1)]
+        t = maglev_table(backends, 251)
+        counts = np.bincount(t, minlength=2)
+        assert 2.0 < counts[0] / counts[1] < 4.5
+
+    def test_deterministic(self):
+        backends = [Backend(f"10.9.0.{i}", 443) for i in range(1, 6)]
+        assert (maglev_table(backends, 251) ==
+                maglev_table(backends, 251)).all()
+
+
+# --------------------------------------------------------------------------- #
+# World with services
+# --------------------------------------------------------------------------- #
+SVC_RULES = [
+    {   # client may egress to backend pods on 8080, not 9090
+        "endpointSelector": {"matchLabels": {"app": "client"}},
+        "egress": [
+            {"toEndpoints": [{"matchLabels": {"app": "be"}}],
+             "toPorts": [{"ports": [{"port": "8080", "protocol": "TCP"}]}]},
+        ],
+    },
+]
+
+
+def build_svc_world():
+    alloc = IdentityAllocator()
+    ipc = IPCache()
+    ctx = PolicyContext(allocator=alloc, selector_cache=SelectorCache(alloc),
+                        ipcache=ipc)
+    repo = Repository(ctx)
+    eps = []
+    cl = Labels.parse(["k8s:app=client"])
+    ident = alloc.allocate(cl)
+    eps.append(Endpoint(ep_id=1, labels=cl, identity_id=ident.id,
+                        ips=("192.168.2.1",)))
+    ipc.upsert("192.168.2.1/32", ident.id)
+    be_lbls = Labels.parse(["k8s:app=be"])
+    be_ident = alloc.allocate(be_lbls)
+    for i in range(1, 4):
+        ipc.upsert(f"10.50.0.{i}/32", be_ident.id)
+    ipc.upsert("10.60.0.1/32", be_ident.id)  # blocked-port backend
+    ctx.services.upsert(Service(
+        name="api", namespace="prod",
+        frontends=(Frontend("172.20.0.10", 80, C.PROTO_TCP),
+                   Frontend("192.168.2.100", 30080, C.PROTO_TCP,
+                            kind="NodePort")),
+        lb_backends=tuple(Backend(f"10.50.0.{i}", 8080)
+                          for i in range(1, 4)),
+    ))
+    ctx.services.upsert(Service(
+        name="blocked", namespace="prod",
+        frontends=(Frontend("172.20.0.11", 80, C.PROTO_TCP),),
+        lb_backends=(Backend("10.60.0.1", 9090),),
+    ))
+    ctx.services.upsert(Service(
+        name="empty", namespace="prod",
+        frontends=(Frontend("172.20.0.12", 80, C.PROTO_TCP),),
+        lb_backends=(),
+    ))
+    repo.add(parse_rules(SVC_RULES))
+    return ctx, repo, eps
+
+
+def svc_packet(rng, dst, dport=80, sport=None, flags=C.TCP_SYN,
+               direction=C.DIR_EGRESS):
+    s16, _ = parse_addr("192.168.2.1")
+    d16, _ = parse_addr(dst)
+    if sport is None:
+        sport = rng.randrange(30000, 60000)
+    if direction == C.DIR_INGRESS:
+        s16, d16 = d16, s16
+        sport, dport = dport, sport
+    return PacketRecord(s16, d16, sport, dport, C.PROTO_TCP, flags,
+                        False, 1, direction)
+
+
+@pytest.fixture(scope="module")
+def svc_world():
+    ctx, repo, eps = build_svc_world()
+    snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=4096),
+                          LBConfig(maglev_m=31))
+    return ctx, snap
+
+
+# --------------------------------------------------------------------------- #
+# Lookup agreement host vs device
+# --------------------------------------------------------------------------- #
+class TestLookupAgreement:
+    def test_np_jnp_agree(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(1)
+        packets = []
+        for _ in range(80):
+            dst = rng.choice(["172.20.0.10", "172.20.0.11", "172.20.0.12",
+                              "10.50.0.1", "8.8.8.8", "192.168.2.100"])
+            dport = rng.choice([80, 81, 8080, 30080])
+            packets.append(svc_packet(rng, dst, dport))
+        batch = batch_from_records(packets, snap.ep_slot_of)
+        tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+        nd, ndp, rn, nb = lb_step(tensors, {k: jnp.asarray(v)
+                                            for k, v in batch.items()})
+        nd2, ndp2, rn2, nb2, _fe = lb_translate_np(snap.lb, batch)
+        np.testing.assert_array_equal(np.asarray(nd), nd2)
+        np.testing.assert_array_equal(np.asarray(ndp), ndp2)
+        np.testing.assert_array_equal(np.asarray(rn), rn2)
+        np.testing.assert_array_equal(np.asarray(nb), nb2)
+
+    def test_frontend_lookup(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(2)
+        batch = batch_from_records(
+            [svc_packet(rng, "172.20.0.10", 80),      # hit fe
+             svc_packet(rng, "172.20.0.10", 81),      # wrong port
+             svc_packet(rng, "8.8.8.8", 80),          # not a vip
+             svc_packet(rng, "192.168.2.100", 30080)],  # nodeport hit
+            snap.ep_slot_of)
+        fe = lb_lookup_np(snap.lb, batch)
+        assert fe[0] >= 0 and fe[3] >= 0
+        assert fe[1] < 0 and fe[2] < 0
+        assert fe[0] != fe[3]
+
+
+# --------------------------------------------------------------------------- #
+# End-to-end parity incl. NAT columns
+# --------------------------------------------------------------------------- #
+def _run_device(snap, ct, packets, now):
+    batch = batch_from_records(packets, snap.ep_slot_of)
+    tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+    out, new_ct, counters = classify_step(
+        tensors, ct, {k: jnp.asarray(v) for k, v in batch.items()},
+        jnp.uint32(now), jnp.int32(snap.world_index))
+    return ({k: np.asarray(v) for k, v in out.items()}, new_ct,
+            {k: np.asarray(v) for k, v in counters.items()})
+
+
+def _check_against_oracle(out, want, packets):
+    for i, v in enumerate(want):
+        assert bool(out["allow"][i]) == v.allow, i
+        assert int(out["reason"][i]) == int(v.drop_reason), i
+        assert int(out["status"][i]) == int(v.ct_status), i
+        assert bool(out["svc"][i]) == v.svc, i
+        if v.svc:
+            assert words_to_addr(out["nat_dst"][i]) == v.nat_dst, i
+            assert int(out["nat_dport"][i]) == v.nat_dport, i
+        assert bool(out["rnat"][i]) == v.rnat, i
+        if v.rnat:
+            assert words_to_addr(out["rnat_src"][i]) == v.rnat_src, i
+            assert int(out["rnat_sport"][i]) == v.rnat_sport, i
+
+
+class TestLBParity:
+    def test_clusterip_flow(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(3)
+        oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                        ctx.ipcache.snapshot(), lb=snap.lb)
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=4096)).items()}
+        now = 1000
+
+        # batch 1: SYNs to the service VIP → translated, allowed, CT created
+        syns = [svc_packet(rng, "172.20.0.10", 80, sport=40000 + i)
+                for i in range(16)]
+        want = oracle.classify_batch_snapshot(syns, now)
+        out, ct, counters = _run_device(snap, ct, syns, now)
+        _check_against_oracle(out, want, syns)
+        assert all(v.allow and v.svc for v in want)
+        # backends actually spread (3 backends, 16 flows)
+        bports = {v.nat_dport for v in want}
+        assert bports == {8080}
+        bips = {v.nat_dst for v in want}
+        assert len(bips) > 1
+        assert extract_device_ct(ct, now) == oracle_live_ct(oracle, now)
+
+        # batch 2: replies from the chosen backends → rev-NAT back to VIP
+        now += 10
+        replies = []
+        for p, v in zip(syns, want):
+            replies.append(PacketRecord(
+                v.nat_dst, p.src_addr, v.nat_dport, p.src_port, C.PROTO_TCP,
+                C.TCP_SYN | C.TCP_ACK, False, 1, C.DIR_INGRESS))
+        want2 = oracle.classify_batch_snapshot(replies, now)
+        out2, ct, _ = _run_device(snap, ct, replies, now)
+        _check_against_oracle(out2, want2, replies)
+        vip16, _ = parse_addr("172.20.0.10")
+        for v in want2:
+            assert v.allow and v.ct_status == C.CTStatus.REPLY
+            assert v.rnat and v.rnat_src == vip16 and v.rnat_sport == 80
+        assert extract_device_ct(ct, now) == oracle_live_ct(oracle, now)
+
+        # batch 3: established forward packets keep the same backend
+        now += 10
+        estab = [PacketRecord(p.src_addr, p.dst_addr, p.src_port, p.dst_port,
+                              C.PROTO_TCP, C.TCP_ACK, False, 1, C.DIR_EGRESS)
+                 for p in syns]
+        want3 = oracle.classify_batch_snapshot(estab, now)
+        out3, ct, _ = _run_device(snap, ct, estab, now)
+        _check_against_oracle(out3, want3, estab)
+        for v0, v3 in zip(want, want3):
+            assert v3.ct_status == C.CTStatus.ESTABLISHED
+            assert v3.nat_dst == v0.nat_dst  # stateless-deterministic pick
+
+    def test_policy_applies_to_backend_port(self, svc_world):
+        """Service 'blocked' DNATs to 9090, which policy does not allow →
+        the flow is dropped by policy on the translated tuple."""
+        ctx, snap = svc_world
+        rng = random.Random(4)
+        oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                        ctx.ipcache.snapshot(), lb=snap.lb)
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=1024)).items()}
+        pkts = [svc_packet(rng, "172.20.0.11", 80) for _ in range(4)]
+        want = oracle.classify_batch_snapshot(pkts, 500)
+        out, ct, _ = _run_device(snap, ct, pkts, 500)
+        _check_against_oracle(out, want, pkts)
+        for v in want:
+            assert not v.allow and v.svc
+            assert v.drop_reason == C.DropReason.POLICY
+            assert v.nat_dport == 9090
+
+    def test_no_backend_drop(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(5)
+        oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                        ctx.ipcache.snapshot(), lb=snap.lb)
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=1024)).items()}
+        pkts = [svc_packet(rng, "172.20.0.12", 80) for _ in range(3)]
+        want = oracle.classify_batch_snapshot(pkts, 500)
+        out, ct, counters = _run_device(snap, ct, pkts, 500)
+        _check_against_oracle(out, want, pkts)
+        for v in want:
+            assert not v.allow
+            assert v.drop_reason == C.DropReason.NO_SERVICE
+        # counted under NO_SERVICE × egress
+        by = counters["by_reason_dir"].reshape(256, 2)
+        assert by[int(C.DropReason.NO_SERVICE), C.DIR_EGRESS] == 3
+        # no CT entries created
+        assert extract_device_ct(ct, 500) == {}
+
+    def test_non_service_traffic_untouched(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(6)
+        oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                        ctx.ipcache.snapshot(), lb=snap.lb)
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=1024)).items()}
+        pkts = [svc_packet(rng, "10.50.0.1", 8080) for _ in range(3)]
+        want = oracle.classify_batch_snapshot(pkts, 500)
+        out, ct, _ = _run_device(snap, ct, pkts, 500)
+        _check_against_oracle(out, want, pkts)
+        for v in want:
+            assert v.allow and not v.svc and not v.rnat
+        live = oracle_live_ct(oracle, 500)
+        assert all(e[4] == 0 for e in live.values())  # rev_nat == 0
+
+    def test_mesh_sharded_lb(self, svc_world):
+        """Sharded classify with service traffic: steering hashes the
+        TRANSLATED tuple so a service flow's forward and reply packets land
+        on the same CT shard."""
+        from cilium_tpu.parallel.mesh import (
+            make_mesh, make_sharded_classify_fn, pad_snapshot_tensors,
+            steer_batch, unsteer_outputs,
+        )
+        ctx, snap = svc_world
+        rng = random.Random(8)
+        n_flow = 4
+        oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                        ctx.ipcache.snapshot(), lb=snap.lb)
+        mesh = make_mesh(n_flow, 1)
+        tensors = {k: jnp.asarray(v)
+                   for k, v in pad_snapshot_tensors(snap.tensors(), 1).items()}
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=4096)).items()}
+        fn = make_sharded_classify_fn(mesh, donate_ct=False)
+        now = 1000
+
+        syns = [svc_packet(rng, "172.20.0.10", 80, sport=42000 + i)
+                for i in range(24)]
+        replies = None
+        for phase in range(2):
+            pkts = syns if phase == 0 else replies
+            want = oracle.classify_batch_snapshot(pkts, now)
+            raw = batch_from_records(pkts, snap.ep_slot_of)
+            steered, scatter, per = steer_batch(raw, n_flow, per_shard=32,
+                                                lb=snap.lb)
+            out, ct, _ = fn(tensors, ct,
+                            {k: jnp.asarray(v) for k, v in steered.items()},
+                            jnp.uint32(now), jnp.int32(snap.world_index))
+            out_np = unsteer_outputs({k: np.asarray(v)
+                                      for k, v in out.items()}, scatter)
+            _check_against_oracle(out_np, want, pkts)
+            assert extract_device_ct(ct, now) == oracle_live_ct(oracle, now)
+            if phase == 0:
+                replies = [PacketRecord(
+                    v.nat_dst, p.src_addr, v.nat_dport, p.src_port,
+                    C.PROTO_TCP, C.TCP_SYN | C.TCP_ACK, False, 1,
+                    C.DIR_INGRESS) for p, v in zip(syns, want)]
+                now += 10
+
+    def test_sequential_snapshot_agree_size1(self, svc_world):
+        ctx, snap = svc_world
+        rng = random.Random(7)
+        o1 = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                    ctx.ipcache.snapshot(), lb=snap.lb)
+        o2 = Oracle(dict(zip(snap.ep_ids, snap.policies)),
+                    ctx.ipcache.snapshot(), lb=snap.lb)
+        now = 100
+        for i in range(40):
+            dst = rng.choice(["172.20.0.10", "172.20.0.11", "172.20.0.12",
+                              "10.50.0.2", "8.8.8.8"])
+            p = svc_packet(rng, dst, 80, sport=41000 + i % 8)
+            v1 = o1.classify(p, now)
+            [v2] = o2.classify_batch_snapshot([p], now)
+            assert v1 == v2, (i, v1, v2)
+            now += 3

@@ -142,6 +142,7 @@ def extract_device_ct(ct_dev, now):
     flags = np.asarray(ct_dev["flags"])
     fwd = np.asarray(ct_dev["pkts_fwd"])
     rev = np.asarray(ct_dev["pkts_rev"])
+    rnat = np.asarray(ct_dev["rev_nat"])
     out = {}
     for slot in np.nonzero(expiry > now)[0]:
         w = keys[slot]
@@ -153,7 +154,7 @@ def extract_device_ct(ct_dev, now):
         d = int(w[9]) & 0xFF
         key = (src, dst, sport, dport, proto, d)
         out[key] = (int(flags[slot]), int(expiry[slot]),
-                    int(fwd[slot]), int(rev[slot]))
+                    int(fwd[slot]), int(rev[slot]), int(rnat[slot]))
     return out
 
 
@@ -161,7 +162,7 @@ def oracle_live_ct(oracle, now):
     out = {}
     for key, e in oracle.ct.entries.items():
         if e.expiry > now:
-            out[key] = (e.flags, e.expiry, e.pkts_fwd, e.pkts_rev)
+            out[key] = (e.flags, e.expiry, e.pkts_fwd, e.pkts_rev, e.rev_nat)
     return out
 
 

@@ -133,6 +133,101 @@ class TestEngine:
         assert bool(out["allow"][0])
 
 
+class TestEngineServices:
+    def test_service_lb_through_engine(self):
+        from cilium_tpu.model.services import Backend, Frontend, Service
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        eng.upsert_service(Service(
+            name="api", namespace="prod",
+            frontends=(Frontend("172.30.0.1", 443, C.PROTO_TCP),),
+            lb_backends=(Backend("10.7.0.1", 443), Backend("10.7.0.2", 443)),
+        ))
+        active = eng.active
+        assert active.snapshot.lb.n_frontends == 1
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "172.30.0.1", 40000, 443)],
+            active.snapshot.ep_slot_of), now=100)
+        assert bool(out["allow"][0]) and bool(out["svc"][0])
+        assert int(out["nat_dport"][0]) == 443
+        # deleting the service recompiles; VIP traffic now hits world/deny
+        eng.delete_service("prod", "api")
+        out2 = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "172.30.0.1", 40001, 443)],
+            eng.active.snapshot.ep_slot_of), now=101)
+        assert not bool(out2["svc"][0])
+
+    def test_rnat_stable_across_service_churn(self):
+        """Rev-NAT ids are stable: adding a service that sorts earlier must
+        not re-point old CT entries at the new VIP, and deleting a service
+        leaves its stale CT entries failing closed (no rewrite)."""
+        from cilium_tpu.model.services import Backend, Frontend, Service
+        from cilium_tpu.utils.ip import words_to_addr
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        eng.upsert_service(Service(
+            name="api", namespace="zzz",
+            frontends=(Frontend("172.30.0.1", 443, C.PROTO_TCP),),
+            lb_backends=(Backend("10.7.0.1", 443),)))
+        slot_of = eng.active.snapshot.ep_slot_of
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "172.30.0.1", 40000, 443)], slot_of),
+            now=100)
+        assert bool(out["svc"][0])
+        # a service that sorts FIRST re-orders frontend indices
+        eng.upsert_service(Service(
+            name="aaa", namespace="aaa",
+            frontends=(Frontend("172.31.0.9", 443, C.PROTO_TCP),),
+            lb_backends=(Backend("10.8.0.1", 443),)))
+        reply = pkt("10.7.0.1", "192.168.1.10", 443, 40000,
+                    flags=C.TCP_SYN | C.TCP_ACK, direction=C.DIR_INGRESS)
+        out2 = eng.classify(batch_from_records(
+            [reply], eng.active.snapshot.ep_slot_of), now=105)
+        assert bool(out2["rnat"][0])
+        vip16, _ = parse_addr("172.30.0.1")   # the ORIGINAL vip, not aaa's
+        assert words_to_addr(out2["rnat_src"][0]) == vip16
+        # delete the original service: stale CT entry → no rewrite at all
+        eng.delete_service("zzz", "api")
+        out3 = eng.classify(batch_from_records(
+            [reply], eng.active.snapshot.ep_slot_of), now=110)
+        assert int(out3["status"][0]) == C.CTStatus.REPLY
+        assert not bool(out3["rnat"][0])
+
+    def test_service_flow_survives_restart(self, tmp_path):
+        from cilium_tpu.model.services import Backend, Frontend, Service
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        eng.upsert_service(Service(
+            name="api", namespace="prod",
+            frontends=(Frontend("172.30.0.1", 443, C.PROTO_TCP),),
+            lb_backends=(Backend("10.7.0.1", 443),),
+        ))
+        slot_of = eng.active.snapshot.ep_slot_of
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "172.30.0.1", 40000, 443)], slot_of),
+            now=100)
+        assert bool(out["svc"][0])
+        save(eng, str(tmp_path / "ckpt"))
+
+        eng2 = small_engine()
+        restore(eng2, str(tmp_path / "ckpt"))
+        # service survives, and the reply still rev-NATs through the
+        # restored CT entry (rev_nat column round-trips)
+        reply = pkt("10.7.0.1", "192.168.1.10", 443, 40000,
+                    flags=C.TCP_SYN | C.TCP_ACK, direction=C.DIR_INGRESS)
+        out2 = eng2.classify(batch_from_records(
+            [reply], eng2.active.snapshot.ep_slot_of), now=105)
+        assert int(out2["status"][0]) == C.CTStatus.REPLY
+        assert bool(out2["rnat"][0])
+        vip16, _ = parse_addr("172.30.0.1")
+        from cilium_tpu.utils.ip import words_to_addr
+        assert words_to_addr(out2["rnat_src"][0]) == vip16
+        assert int(out2["rnat_sport"][0]) == 443
+
+
 class TestCheckpoint:
     def test_flows_survive_restart(self, tmp_path):
         eng = small_engine()

@@ -120,6 +120,52 @@ class TestShimParse:
         np.testing.assert_array_equal(np.asarray(got), want[:16])
         s.close()
 
+    def test_steering_with_lb_matches_python(self):
+        """Service traffic: the shim's steering applies the same DNAT the
+        kernel does, so it matches flow_shard_of(..., lb=lb) — forward and
+        reply of a service flow agree on the shard."""
+        from cilium_tpu.shim.bindings import FlowShim, build_frame
+        from cilium_tpu.parallel.mesh import flow_shard_of
+        from cilium_tpu.compile.lb import LBConfig, build_lb
+        from cilium_tpu.model.services import Backend, Frontend, Service
+        lb = build_lb([Service(
+            name="api", namespace="prod",
+            frontends=(Frontend("10.96.0.10", 443, C.PROTO_TCP),),
+            lb_backends=(Backend("10.50.0.1", 8443),
+                         Backend("10.50.0.2", 8443)),
+        )], LBConfig(maglev_m=31))
+        s = FlowShim(batch_size=32, timeout_us=0)
+        s.register_endpoint("192.168.1.10", 1)
+        s.set_lb(lb)
+        rng = random.Random(6)
+        sports = [rng.randrange(1024, 65535) for _ in range(8)]
+        for sp in sports:       # VIP traffic
+            s.feed_frame(build_frame("192.168.1.10", "10.96.0.10", sp, 443))
+        for sp in sports[:4]:   # non-service traffic
+            s.feed_frame(build_frame("192.168.1.10", "10.77.0.1", sp, 443))
+        b = s.poll_batch(force=True)
+        n = 12
+        want = flow_shard_of(b, 8, lb=lb)
+        got = [s.flow_shard(i, 8) for i in range(n)]
+        np.testing.assert_array_equal(np.asarray(got), want[:n])
+        # the reply direction (backend → client) must land on the same shard
+        from cilium_tpu.compile.lb import lb_translate_np
+        new_dst, new_dport, _rn, _nb, _fe = lb_translate_np(lb, b)
+        s2 = FlowShim(batch_size=32, timeout_us=0)
+        s2.register_endpoint("192.168.1.10", 1)
+        s2.set_lb(lb)
+        from cilium_tpu.utils.ip import words_to_addr, addr_to_str
+        for i in range(8):
+            s2.feed_frame(build_frame(
+                addr_to_str(words_to_addr(new_dst[i])), "192.168.1.10",
+                int(new_dport[i]), int(b["sport"][i]),
+                tcp_flags=C.TCP_SYN | C.TCP_ACK))
+        b2 = s2.poll_batch(force=True)
+        got2 = [s2.flow_shard(i, 8) for i in range(8)]
+        np.testing.assert_array_equal(np.asarray(got2), np.asarray(got[:8]))
+        s2.close()
+        s.close()
+
     def test_afxdp_bind_succeeds_or_fails_gracefully(self):
         # In a privileged VM (this CI image) the socket+UMEM+bind sequence
         # succeeds on loopback; unprivileged containers get a clean -errno.
