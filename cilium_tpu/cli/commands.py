@@ -1,17 +1,376 @@
-"""CLI subcommand registry. Commands are added as subsystems land."""
+"""CLI subcommands (analog of upstream ``cilium-dbg``: endpoint/policy/
+service/ct inspection + ``policy trace``, the parity debugging tool).
+
+All inspection commands operate on a checkpoint state dir
+(``--state-dir``, the /var/run/cilium analog) through
+``checkpoint.load_host`` — pure host code, NO jax import, no device claim.
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
+import sys
+
+from cilium_tpu.utils import constants as C
 
 
 def register(sub: "argparse._SubParsersAction") -> None:
-    p_version = sub.add_parser("version", help="print framework version")
-    p_version.set_defaults(func=_cmd_version)
+    p = sub.add_parser("version", help="print framework version")
+    p.set_defaults(func=_cmd_version)
+
+    p = sub.add_parser("status", help="agent state summary from a state dir")
+    _add_state_dir(p)
+    p.set_defaults(func=_cmd_status)
+
+    p = sub.add_parser("endpoint", help="endpoint inspection")
+    esub = p.add_subparsers(dest="subcmd", required=True)
+    pl = esub.add_parser("list", help="list endpoints")
+    _add_state_dir(pl)
+    pl.set_defaults(func=_cmd_endpoint_list)
+    pg = esub.add_parser("get", help="one endpoint incl. policy summary")
+    _add_state_dir(pg)
+    pg.add_argument("ep_id", type=int)
+    pg.set_defaults(func=_cmd_endpoint_get)
+
+    p = sub.add_parser("identity", help="identity inspection")
+    isub = p.add_subparsers(dest="subcmd", required=True)
+    il = isub.add_parser("list", help="list security identities")
+    _add_state_dir(il)
+    il.set_defaults(func=_cmd_identity_list)
+
+    p = sub.add_parser("policy", help="policy inspection + trace")
+    psub = p.add_subparsers(dest="subcmd", required=True)
+    pg = psub.add_parser("get", help="dump the rule documents")
+    _add_state_dir(pg)
+    pg.set_defaults(func=_cmd_policy_get)
+    pt = psub.add_parser(
+        "trace", help="trace one (endpoint, flow) through the policy ladder "
+        "(upstream: cilium policy trace)")
+    _add_state_dir(pt)
+    pt.add_argument("--ep", type=int, required=True, help="local endpoint id")
+    pt.add_argument("--direction", choices=["egress", "ingress"],
+                    default="egress")
+    pt.add_argument("--remote", required=True,
+                    help="remote IP (resolved via ipcache LPM)")
+    pt.add_argument("--dport", type=int, required=True)
+    pt.add_argument("--proto", default="TCP",
+                    help="TCP|UDP|SCTP|ICMP|ICMPv6 or a number")
+    pt.set_defaults(func=_cmd_policy_trace)
+
+    p = sub.add_parser("service", help="service/LB inspection")
+    ssub = p.add_subparsers(dest="subcmd", required=True)
+    sl = ssub.add_parser("list", help="list services, frontends, backends")
+    _add_state_dir(sl)
+    sl.set_defaults(func=_cmd_service_list)
+
+    p = sub.add_parser("ct", help="conntrack inspection")
+    csub = p.add_subparsers(dest="subcmd", required=True)
+    cl = csub.add_parser("list", help="list live CT entries from ct.npz")
+    _add_state_dir(cl)
+    cl.add_argument("--now", type=int, default=None,
+                    help="wall-clock for liveness (default: max created)")
+    cl.add_argument("--limit", type=int, default=64)
+    cl.set_defaults(func=_cmd_ct_list)
+
+    p = sub.add_parser(
+        "map", help="compiled policy-map inspection (cilium bpf policy get)")
+    msub = p.add_subparsers(dest="subcmd", required=True)
+    mg = msub.add_parser("get", help="dump one endpoint's MapState entries")
+    _add_state_dir(mg)
+    mg.add_argument("--ep", type=int, required=True)
+    mg.add_argument("--direction", choices=["egress", "ingress"],
+                    default=None, help="default: both")
+    mg.set_defaults(func=_cmd_map_get)
 
 
+def _add_state_dir(p):
+    p.add_argument("--state-dir", required=True,
+                   help="checkpoint dir written by the engine "
+                        "(the /var/run/cilium analog)")
+    p.add_argument("-o", "--output", choices=["text", "json"], default="text")
+
+
+def _load(args):
+    from cilium_tpu.runtime.checkpoint import load_host
+    return load_host(args.state_dir)
+
+
+def _emit(args, doc, text_fn) -> int:
+    if args.output == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        text_fn(doc)
+    return 0
+
+
+def _proto_num(text: str) -> int:
+    if text.isdigit():
+        return int(text)
+    for num, name in C.PROTO_NAMES.items():
+        if name.upper() == text.upper():
+            return num
+    raise SystemExit(f"unknown protocol {text!r}")
+
+
+# --------------------------------------------------------------------------- #
 def _cmd_version(args) -> int:
     import cilium_tpu
     print(json.dumps({"version": cilium_tpu.__version__}))
     return 0
+
+
+def _cmd_status(args) -> int:
+    st = _load(args)
+    ct_doc = None
+    if st.ct is not None:
+        expiry = st.ct["expiry"]
+        now = int(st.ct["created"].max()) if expiry.size else 0
+        ct_doc = {"capacity": int(expiry.shape[0]),
+                  "live": int((expiry > now).sum())}
+    doc = {
+        "revision": st.revision,
+        "endpoints": len(st.endpoints),
+        "identities": len(list(st.ctx.allocator.all())),
+        "rules": len(st.repo),
+        "ipcache_entries": len(st.ctx.ipcache.snapshot()),
+        "services": len(st.ctx.services.all()),
+        "conntrack": ct_doc,
+        "enforcement_mode": st.ctx.enforcement_mode,
+    }
+
+    def text(d):
+        print(f"Policy revision:  {d['revision']}")
+        print(f"Endpoints:        {d['endpoints']}")
+        print(f"Identities:       {d['identities']}")
+        print(f"Rules:            {d['rules']}")
+        print(f"IPCache entries:  {d['ipcache_entries']}")
+        print(f"Services:         {d['services']}")
+        if d["conntrack"]:
+            print(f"Conntrack:        {d['conntrack']['live']}/"
+                  f"{d['conntrack']['capacity']} live")
+        print(f"Enforcement:      {d['enforcement_mode']}")
+    return _emit(args, doc, text)
+
+
+def _cmd_endpoint_list(args) -> int:
+    st = _load(args)
+    doc = [{"ep_id": ep.ep_id, "identity": ep.identity_id,
+            "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
+            "enforcement": ep.enforcement}
+           for ep in sorted(st.endpoints.values(), key=lambda e: e.ep_id)]
+
+    def text(d):
+        for e in d:
+            print(f"{e['ep_id']:<6} id={e['identity']:<8} "
+                  f"ips={','.join(e['ips']) or '-':<24} "
+                  f"labels={','.join(e['labels'])}")
+    return _emit(args, doc, text)
+
+
+def _cmd_endpoint_get(args) -> int:
+    st = _load(args)
+    ep = st.endpoints.get(args.ep_id)
+    if ep is None:
+        print(f"endpoint {args.ep_id} not found", file=sys.stderr)
+        return 1
+    pol = st.repo.resolve(ep)
+    doc = {
+        "ep_id": ep.ep_id, "identity": ep.identity_id,
+        "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
+        "enforcement": ep.enforcement,
+        "policy_revision": pol.revision,
+        "egress": {"enforced": pol.egress.enforced,
+                   "entries": len(pol.egress.mapstate.items())},
+        "ingress": {"enforced": pol.ingress.enforced,
+                    "entries": len(pol.ingress.mapstate.items())},
+    }
+    return _emit(args, doc, lambda d: print(json.dumps(d, indent=2)))
+
+
+def _cmd_identity_list(args) -> int:
+    st = _load(args)
+    doc = []
+    for ident in st.ctx.allocator.all():
+        doc.append({"id": ident.id,
+                    "labels": list(ident.labels.to_strings()),
+                    "reserved": ident.id < C.CLUSTER_IDENTITY_BASE,
+                    "local": bool(ident.id & C.LOCAL_IDENTITY_SCOPE)})
+
+    def text(d):
+        for e in d:
+            kind = ("reserved" if e["reserved"]
+                    else "cidr" if e["local"] else "cluster")
+            print(f"{e['id']:<10} {kind:<9} {','.join(e['labels'])}")
+    return _emit(args, doc, text)
+
+
+def _cmd_policy_get(args) -> int:
+    st = _load(args)
+    doc = [r.raw for r in st.repo.all_rules() if r.raw is not None]
+    return _emit(args, doc, lambda d: print(json.dumps(d, indent=2)))
+
+
+def _key_str(key) -> str:
+    ident = "ANY" if key.identity == C.IDENTITY_ANY else str(key.identity)
+    proto = C.PROTO_NAMES.get(key.proto, str(key.proto))
+    if key.is_port_wild:
+        ports = "*"
+    elif key.port_lo == key.port_hi:
+        ports = str(key.port_lo)
+    else:
+        ports = f"{key.port_lo}-{key.port_hi}"
+    return f"id={ident} proto={proto} port={ports}"
+
+
+def _cmd_policy_trace(args) -> int:
+    st = _load(args)
+    ep = st.endpoints.get(args.ep)
+    if ep is None:
+        print(f"endpoint {args.ep} not found", file=sys.stderr)
+        return 1
+    from cilium_tpu.model.ipcache import lpm_lookup
+    direction = C.DIR_EGRESS if args.direction == "egress" else C.DIR_INGRESS
+    proto = _proto_num(args.proto)
+    remote_id = lpm_lookup(st.ctx.ipcache.snapshot(), args.remote)
+    pol = st.repo.resolve(ep)
+    dirpol = pol.direction(direction)
+    res = dirpol.lookup(remote_id, proto, args.dport) if dirpol.enforced \
+        else None
+    if not dirpol.enforced:
+        verdict, reason = "ALLOWED", "direction not enforced (default mode)"
+    elif res.decision == C.VERDICT_DENY:
+        verdict, reason = "DENIED", "explicit deny rule"
+    elif res.decision == C.VERDICT_MISS:
+        verdict, reason = "DENIED", "no rule matched (default deny)"
+    elif res.decision == C.VERDICT_REDIRECT:
+        verdict = "ALLOWED"
+        reason = "L7 redirect (http rules apply per request)"
+    else:
+        verdict, reason = "ALLOWED", "allow rule matched"
+    doc = {
+        "endpoint": ep.ep_id,
+        "direction": args.direction,
+        "remote": args.remote,
+        "remote_identity": remote_id,
+        "dport": args.dport,
+        "proto": C.PROTO_NAMES.get(proto, str(proto)),
+        "enforced": dirpol.enforced,
+        "verdict": verdict,
+        "reason": reason,
+        "matched_key": _key_str(res.key)
+        if res is not None and res.key is not None else None,
+        "derived_from": list(res.entry.derived_from)
+        if res is not None and res.entry is not None else [],
+        "l7_rules": [repr(r) for r in sorted(res.entry.l7_rules, key=repr)]
+        if res is not None and res.entry is not None
+        and res.entry.l7_rules else [],
+    }
+
+    def text(d):
+        print(f"Tracing {d['direction']} from endpoint {d['endpoint']} "
+              f"to {d['remote']} (identity {d['remote_identity']}) "
+              f"port {d['dport']}/{d['proto']}")
+        print(f"  enforced:    {d['enforced']}")
+        if d["matched_key"]:
+            print(f"  matched key: {d['matched_key']}")
+        for src in d["derived_from"]:
+            print(f"    derived from: {src}")
+        for r in d["l7_rules"]:
+            print(f"    l7: {r}")
+        print(f"Final verdict: {d['verdict']} ({d['reason']})")
+    return _emit(args, doc, text)
+
+
+def _cmd_service_list(args) -> int:
+    st = _load(args)
+    doc = []
+    for svc in st.ctx.services.all():
+        doc.append({
+            "name": f"{svc.namespace}/{svc.name}",
+            "frontends": [f"{f.addr}:{f.port}/"
+                          f"{C.PROTO_NAMES.get(f.proto, f.proto)} ({f.kind})"
+                          for f in svc.frontends],
+            "backends": [f"{b.addr}:{b.port} (w={b.weight})"
+                         for b in svc.lb_backends] or list(svc.backends),
+        })
+
+    def text(d):
+        for s in d:
+            print(s["name"])
+            for f in s["frontends"]:
+                print(f"  frontend {f}")
+            for b in s["backends"]:
+                print(f"  backend  {b}")
+    return _emit(args, doc, text)
+
+
+def _cmd_ct_list(args) -> int:
+    import numpy as np
+    from cilium_tpu.utils.ip import addr_to_str, words_to_addr
+    st = _load(args)
+    if st.ct is None:
+        print("no ct.npz in state dir", file=sys.stderr)
+        return 1
+    keys = st.ct["keys"]
+    expiry = st.ct["expiry"]
+    now = args.now if args.now is not None else (
+        int(st.ct["created"].max()) if expiry.size else 0)
+    live = np.nonzero(expiry > now)[0]
+    entries = []
+    for slot in live[: args.limit]:
+        w = keys[slot]
+        entries.append({
+            "src": addr_to_str(words_to_addr(w[0:4])),
+            "dst": addr_to_str(words_to_addr(w[4:8])),
+            "sport": int(w[8]) >> 16,
+            "dport": int(w[8]) & 0xFFFF,
+            "proto": C.PROTO_NAMES.get(int(w[9]) >> 8, str(int(w[9]) >> 8)),
+            "dir": C.DIR_NAMES[int(w[9]) & 0xFF],
+            "expires_in": int(expiry[slot]) - now,
+            "pkts_fwd": int(st.ct["pkts_fwd"][slot]),
+            "pkts_rev": int(st.ct["pkts_rev"][slot]),
+            "rev_nat": int(st.ct["rev_nat"][slot])
+            if "rev_nat" in st.ct else 0,
+        })
+    doc = {"live": int(live.size), "now": now, "entries": entries}
+
+    def text(d):
+        print(f"{d['live']} live entries (now={d['now']}):")
+        for e in d["entries"]:
+            rn = f" rnat={e['rev_nat']}" if e["rev_nat"] else ""
+            print(f"  {e['proto']:<5} {e['src']}:{e['sport']} -> "
+                  f"{e['dst']}:{e['dport']} [{e['dir']}] "
+                  f"ttl={e['expires_in']}s fwd={e['pkts_fwd']} "
+                  f"rev={e['pkts_rev']}{rn}")
+    return _emit(args, doc, text)
+
+
+def _cmd_map_get(args) -> int:
+    st = _load(args)
+    ep = st.endpoints.get(args.ep)
+    if ep is None:
+        print(f"endpoint {args.ep} not found", file=sys.stderr)
+        return 1
+    pol = st.repo.resolve(ep)
+    directions = ([C.DIR_EGRESS, C.DIR_INGRESS] if args.direction is None
+                  else [C.DIR_EGRESS if args.direction == "egress"
+                        else C.DIR_INGRESS])
+    doc = []
+    for d in directions:
+        dirpol = pol.direction(d)
+        for key, entry in dirpol.mapstate.items():
+            doc.append({
+                "direction": C.DIR_NAMES[d],
+                "key": _key_str(key),
+                "action": ("DENY" if entry.deny
+                           else "REDIRECT" if entry.is_redirect else "ALLOW"),
+                "l7_rules": len(entry.l7_rules or ()),
+                "derived_from": list(entry.derived_from),
+            })
+
+    def text(dl):
+        for e in dl:
+            l7 = f" l7={e['l7_rules']}" if e["l7_rules"] else ""
+            print(f"{e['direction']:<8} {e['key']:<40} {e['action']}{l7}")
+    return _emit(args, doc, text)

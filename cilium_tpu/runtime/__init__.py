@@ -1,9 +1,18 @@
 """Host runtime: engine, config, controllers, checkpoint, metrics, flow log
 (analogs of upstream ``daemon/``, ``pkg/option``, ``pkg/controller`` /
 ``pkg/trigger``, endpoint-state checkpointing, ``pkg/metrics``, Hubble-lite).
+
+``Engine`` is exported lazily: it pulls in jax, and the CLI's host-only
+inspection path (checkpoint.load_host) must import without it.
 """
 
 from cilium_tpu.runtime.config import DaemonConfig
-from cilium_tpu.runtime.engine import Engine
 
 __all__ = ["DaemonConfig", "Engine"]
+
+
+def __getattr__(name):
+    if name == "Engine":
+        from cilium_tpu.runtime.engine import Engine
+        return Engine
+    raise AttributeError(name)

@@ -18,12 +18,15 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Dict, Optional
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Dict, Optional
 
 import numpy as np
 
 from cilium_tpu.model.services import Backend, Frontend, Service
-from cilium_tpu.runtime.engine import Engine
+
+if TYPE_CHECKING:  # Engine pulls in jax; load_host() must stay jax-free
+    from cilium_tpu.runtime.engine import Engine
 
 STATE_FILE = "state.json"
 CT_FILE = "ct.npz"
@@ -35,6 +38,12 @@ def save(engine: Engine, path: str) -> None:
     state = {
         "format_version": FORMAT_VERSION,
         "revision": engine.repo.revision,
+        # verdict-relevant config: the CLI's trace/status must evaluate with
+        # the agent's actual enforcement semantics, not defaults
+        "config": {
+            "enforcement_mode": engine.ctx.enforcement_mode,
+            "allow_localhost": engine.ctx.allow_localhost,
+        },
         "identity_state": engine.ctx.allocator.export_state(),
         "ipcache": engine.ctx.ipcache.snapshot(),
         "endpoints": [
@@ -70,23 +79,36 @@ def save(engine: Engine, path: str) -> None:
     os.replace(tmp, os.path.join(path, CT_FILE))
 
 
-def restore(engine: Engine, path: str) -> None:
-    """Restore host + CT state into a FRESH engine (no endpoints/rules yet)."""
+def _read_state(path: str) -> Dict:
     with open(os.path.join(path, STATE_FILE)) as f:
         state = json.load(f)
     if state.get("format_version") != FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint version "
                          f"{state.get('format_version')}")
-    if engine.endpoints or len(engine.repo):
-        raise ValueError("restore requires a fresh engine")
+    return state
 
+
+def _rebuild_control_plane(state: Dict, ctx, repo,
+                           add_endpoint: Callable,
+                           apply_rules: Callable) -> None:
+    """The single definition of "state dict → control-plane state", shared by
+    engine restore and the CLI's host-only load so the two can never diverge.
+
+    ``add_endpoint(ep_doc)`` and ``apply_rules(rule_docs)`` abstract the only
+    difference between the two callers (engine methods vs plain objects).
+    """
+    cfg = state.get("config", {})
+    if "enforcement_mode" in cfg:
+        ctx.enforcement_mode = cfg["enforcement_mode"]
+    if "allow_localhost" in cfg:
+        ctx.allow_localhost = cfg["allow_localhost"]
     # identity numbering must be restored FIRST so that endpoint/CIDR
     # allocation below resolves to the same ids (idempotent via label lookup)
-    engine.ctx.allocator.restore_state(state["identity_state"])
+    ctx.allocator.restore_state(state["identity_state"])
     if "rnat_state" in state:
-        engine.ctx.services.restore_rnat_state(state["rnat_state"])
+        ctx.services.restore_rnat_state(state["rnat_state"])
     for svc in state.get("services", []):
-        engine.ctx.services.upsert(Service(
+        ctx.services.upsert(Service(
             name=svc["name"], namespace=svc["namespace"],
             backends=tuple(svc["backends"]),
             frontends=tuple(Frontend(**f)
@@ -94,18 +116,85 @@ def restore(engine: Engine, path: str) -> None:
             lb_backends=tuple(Backend(**b)
                               for b in svc.get("lb_backends", []))))
     for ep in state["endpoints"]:
-        engine.add_endpoint(ep["labels"], ep["ips"], ep_id=ep["ep_id"],
-                            enforcement=ep.get("enforcement"))
+        add_endpoint(ep)
     if state["rules"]:
-        engine.apply_policy(state["rules"])
+        apply_rules(state["rules"])
     # ipcache entries not re-derivable (e.g. manual upserts) are replayed
-    current = engine.ctx.ipcache.snapshot()
+    current = ctx.ipcache.snapshot()
     for prefix, ident in state["ipcache"].items():
         if prefix not in current:
-            engine.ctx.ipcache.upsert(prefix, ident)
+            ctx.ipcache.upsert(prefix, ident)
 
+
+def _read_ct(path: str) -> Optional[Dict[str, np.ndarray]]:
     ct_path = os.path.join(path, CT_FILE)
-    if os.path.exists(ct_path):
-        with np.load(ct_path) as npz:
-            engine.load_ct_arrays({k: npz[k] for k in npz.files})
+    if not os.path.exists(ct_path):
+        return None
+    with np.load(ct_path) as npz:
+        return {k: npz[k] for k in npz.files}
+
+
+def restore(engine: Engine, path: str) -> None:
+    """Restore host + CT state into a FRESH engine (no endpoints/rules yet)."""
+    state = _read_state(path)
+    if engine.endpoints or len(engine.repo):
+        raise ValueError("restore requires a fresh engine")
+    _rebuild_control_plane(
+        state, engine.ctx, engine.repo,
+        add_endpoint=lambda ep: engine.add_endpoint(
+            ep["labels"], ep["ips"], ep_id=ep["ep_id"],
+            enforcement=ep.get("enforcement")),
+        apply_rules=engine.apply_policy)
+    ct = _read_ct(path)
+    if ct is not None:
+        engine.load_ct_arrays(ct)
     engine.regenerate(force=True)
+
+
+# --------------------------------------------------------------------------- #
+# Host-only load (CLI inspection/trace): reconstructs the control-plane state
+# with NO jax import and NO device placement — the analog of cilium-dbg
+# reading agent state without touching the datapath.
+# --------------------------------------------------------------------------- #
+@dataclass
+class HostState:
+    ctx: "PolicyContext"
+    repo: "Repository"
+    endpoints: Dict[int, "Endpoint"]
+    revision: int
+    ct: Optional[Dict[str, np.ndarray]]     # raw CT arrays (None if absent)
+    raw: Dict                               # the state.json document
+
+
+def load_host(path: str) -> HostState:
+    from cilium_tpu.model.endpoint import Endpoint
+    from cilium_tpu.model.labels import Labels
+    from cilium_tpu.model.identity import IdentityAllocator
+    from cilium_tpu.model.ipcache import IPCache
+    from cilium_tpu.model.rules import parse_rules
+    from cilium_tpu.model.services import ServiceRegistry
+    from cilium_tpu.policy.repository import PolicyContext, Repository
+    from cilium_tpu.policy.selectorcache import SelectorCache
+
+    state = _read_state(path)
+    alloc = IdentityAllocator()
+    ctx = PolicyContext(allocator=alloc,
+                        selector_cache=SelectorCache(alloc),
+                        ipcache=IPCache(), services=ServiceRegistry())
+    repo = Repository(ctx)
+    endpoints: Dict[int, Endpoint] = {}
+
+    def add_endpoint(ep):
+        lbls = Labels.parse(ep["labels"])
+        ident = alloc.allocate(lbls)
+        endpoints[ep["ep_id"]] = Endpoint(
+            ep_id=ep["ep_id"], labels=lbls, ips=tuple(ep["ips"]),
+            identity_id=ident.id, enforcement=ep.get("enforcement"))
+        for ip in ep["ips"]:
+            prefix = f"{ip}/128" if ":" in ip else f"{ip}/32"
+            ctx.ipcache.upsert(prefix, ident.id)
+
+    _rebuild_control_plane(state, ctx, repo, add_endpoint=add_endpoint,
+                           apply_rules=lambda docs: repo.add(parse_rules(docs)))
+    return HostState(ctx=ctx, repo=repo, endpoints=endpoints,
+                     revision=state["revision"], ct=_read_ct(path), raw=state)

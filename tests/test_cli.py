@@ -1,0 +1,189 @@
+"""CLI tests: every cilium-dbg-analog command against a real checkpoint
+state dir, plus the jax-free-import guarantee for the inspection path."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cilium_tpu.cli.main import main as cli_main
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.model.services import Backend, Frontend, Service
+from cilium_tpu.runtime.checkpoint import save
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import PacketRecord
+
+POLICY = [{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toCIDR": ["10.0.0.0/8"],
+                "toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]}],
+    "egressDeny": [{"toCIDR": ["10.66.0.0/16"]}],
+    "ingress": [{"toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                              "rules": {"http": [
+                                  {"method": "GET", "path": "/api"}]}}]}],
+}]
+
+
+@pytest.fixture(scope="module")
+def state_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("state")
+    eng = Engine(DaemonConfig(ct_capacity=4096, auto_regen=False))
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+    eng.apply_policy(POLICY)
+    eng.upsert_service(Service(
+        name="api", namespace="prod",
+        frontends=(Frontend("172.30.0.1", 443, C.PROTO_TCP),),
+        lb_backends=(Backend("10.7.0.1", 443),)))
+    s16, _ = parse_addr("192.168.1.10")
+    d16, _ = parse_addr("10.1.2.3")
+    eng.classify(batch_from_records(
+        [PacketRecord(s16, d16, 40000, 443, C.PROTO_TCP, C.TCP_SYN, False,
+                      1, C.DIR_EGRESS)],
+        eng.active.snapshot.ep_slot_of), now=100)
+    save(eng, str(d))
+    return str(d)
+
+
+def run_cli(capsys, *argv):
+    rc = cli_main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def run_json(capsys, *argv):
+    rc, out = run_cli(capsys, *argv, "-o", "json")
+    assert rc == 0, out
+    return json.loads(out)
+
+
+class TestCLI:
+    def test_version(self, capsys):
+        rc, out = run_cli(capsys, "version")
+        assert rc == 0 and "version" in out
+
+    def test_status(self, state_dir, capsys):
+        doc = run_json(capsys, "status", "--state-dir", state_dir)
+        assert doc["endpoints"] == 1
+        assert doc["services"] == 1
+        assert doc["conntrack"]["live"] == 1
+
+    def test_endpoint_list_get(self, state_dir, capsys):
+        doc = run_json(capsys, "endpoint", "list", "--state-dir", state_dir)
+        assert doc[0]["ep_id"] == 1 and "192.168.1.10" in doc[0]["ips"]
+        doc = run_json(capsys, "endpoint", "get", "--state-dir", state_dir,
+                       "1")
+        assert doc["egress"]["enforced"] is True
+        assert doc["egress"]["entries"] >= 2
+
+    def test_identity_list(self, state_dir, capsys):
+        doc = run_json(capsys, "identity", "list", "--state-dir", state_dir)
+        ids = {e["id"] for e in doc}
+        assert C.IDENTITY_WORLD in ids
+        assert any(e["id"] >= C.CLUSTER_IDENTITY_BASE for e in doc)
+
+    def test_policy_get(self, state_dir, capsys):
+        doc = run_json(capsys, "policy", "get", "--state-dir", state_dir)
+        assert doc == POLICY
+
+    def test_policy_trace_allow(self, state_dir, capsys):
+        doc = run_json(capsys, "policy", "trace", "--state-dir", state_dir,
+                       "--ep", "1", "--remote", "10.1.2.3",
+                       "--dport", "443", "--proto", "TCP")
+        assert doc["verdict"] == "ALLOWED"
+        assert doc["matched_key"] is not None
+        assert doc["derived_from"]
+
+    def test_policy_trace_deny_precedence(self, state_dir, capsys):
+        doc = run_json(capsys, "policy", "trace", "--state-dir", state_dir,
+                       "--ep", "1", "--remote", "10.66.1.1",
+                       "--dport", "443")
+        assert doc["verdict"] == "DENIED"
+        assert doc["reason"] == "explicit deny rule"
+
+    def test_policy_trace_default_deny(self, state_dir, capsys):
+        doc = run_json(capsys, "policy", "trace", "--state-dir", state_dir,
+                       "--ep", "1", "--remote", "8.8.8.8", "--dport", "22")
+        assert doc["verdict"] == "DENIED"
+        assert doc["matched_key"] is None
+
+    def test_policy_trace_l7(self, state_dir, capsys):
+        doc = run_json(capsys, "policy", "trace", "--state-dir", state_dir,
+                       "--ep", "1", "--direction", "ingress",
+                       "--remote", "8.8.8.8", "--dport", "80")
+        assert doc["verdict"] == "ALLOWED"
+        assert "L7" in doc["reason"]
+        assert doc["l7_rules"]
+
+    def test_service_list(self, state_dir, capsys):
+        doc = run_json(capsys, "service", "list", "--state-dir", state_dir)
+        assert doc[0]["name"] == "prod/api"
+        assert any("172.30.0.1:443" in f for f in doc[0]["frontends"])
+
+    def test_ct_list(self, state_dir, capsys):
+        doc = run_json(capsys, "ct", "list", "--state-dir", state_dir,
+                       "--now", "100")
+        assert doc["live"] == 1
+        e = doc["entries"][0]
+        assert e["src"] == "192.168.1.10" and e["dst"] == "10.1.2.3"
+        assert e["dport"] == 443 and e["proto"] == "TCP"
+
+    def test_map_get(self, state_dir, capsys):
+        doc = run_json(capsys, "map", "get", "--state-dir", state_dir,
+                       "--ep", "1")
+        actions = {e["action"] for e in doc}
+        assert {"ALLOW", "DENY", "REDIRECT"} <= actions
+
+    def test_text_output(self, state_dir, capsys):
+        rc, out = run_cli(capsys, "policy", "trace", "--state-dir", state_dir,
+                          "--ep", "1", "--remote", "10.66.1.1",
+                          "--dport", "443")
+        assert rc == 0 and "Final verdict: DENIED" in out
+
+    def test_unknown_endpoint(self, state_dir, capsys):
+        rc = cli_main(["endpoint", "get", "--state-dir", state_dir, "99"])
+        assert rc == 1
+
+
+class TestEnforcementModePersistence:
+    def test_trace_uses_checkpointed_enforcement(self, tmp_path, capsys):
+        """'always' mode must survive into the CLI: an unselected endpoint is
+        default-denied by the datapath, and trace must agree (the parity
+        tool may not contradict the datapath)."""
+        eng = Engine(DaemonConfig(ct_capacity=4096, auto_regen=False,
+                                  enforcement_mode="always"))
+        eng.add_endpoint(["k8s:app=lonely"], ips=("192.168.3.1",), ep_id=1)
+        eng.active
+        save(eng, str(tmp_path / "s"))
+        doc = run_json(capsys, "policy", "trace", "--state-dir",
+                       str(tmp_path / "s"), "--ep", "1",
+                       "--remote", "8.8.8.8", "--dport", "443")
+        assert doc["enforced"] is True
+        assert doc["verdict"] == "DENIED"
+        doc = run_json(capsys, "status", "--state-dir", str(tmp_path / "s"))
+        assert doc["enforcement_mode"] == "always"
+
+
+class TestJaxFree:
+    def test_inspection_never_imports_jax(self, state_dir):
+        """The CLI inspection path must not import jax (no device claim):
+        run in a subprocess with jax poisoned."""
+        code = (
+            "import sys; sys.modules['jax'] = None\n"
+            "from cilium_tpu.cli.main import main\n"
+            f"rc = main(['status', '--state-dir', {state_dir!r}])\n"
+            "assert rc == 0\n"
+            f"rc = main(['policy', 'trace', '--state-dir', {state_dir!r},"
+            "'--ep', '1', '--remote', '10.1.2.3', '--dport', '443'])\n"
+            "assert rc == 0\n"
+            "print('JAXFREE-OK')\n"
+        )
+        import pathlib
+        repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120,
+                           cwd=repo_root)
+        assert "JAXFREE-OK" in r.stdout, r.stdout + r.stderr
