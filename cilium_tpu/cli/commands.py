@@ -64,6 +64,12 @@ def register(sub: "argparse._SubParsersAction") -> None:
     _add_state_dir(sl)
     sl.set_defaults(func=_cmd_service_list)
 
+    p = sub.add_parser("fqdn", help="FQDN/DNS-cache inspection")
+    fsub = p.add_subparsers(dest="subcmd", required=True)
+    fc = fsub.add_parser("cache", help="list learned DNS names and IPs")
+    _add_state_dir(fc)
+    fc.set_defaults(func=_cmd_fqdn_cache)
+
     p = sub.add_parser("ct", help="conntrack inspection")
     csub = p.add_subparsers(dest="subcmd", required=True)
     cl = csub.add_parser("list", help="list live CT entries from ct.npz")
@@ -302,6 +308,19 @@ def _cmd_service_list(args) -> int:
                 print(f"  frontend {f}")
             for b in s["backends"]:
                 print(f"  backend  {b}")
+    return _emit(args, doc, text)
+
+
+def _cmd_fqdn_cache(args) -> int:
+    st = _load(args)
+    doc = [{"name": name, "ips": {ip: exp for ip, exp in sorted(e.items())}}
+           for name, e in st.ctx.fqdn_cache.names()]
+
+    def text(d):
+        for e in d:
+            print(e["name"])
+            for ip, exp in e["ips"].items():
+                print(f"  {ip}  expires={exp}")
     return _emit(args, doc, text)
 
 

@@ -1,0 +1,149 @@
+"""FQDN policy support (analog of upstream ``pkg/fqdn``): a DNS cache
+mapping names → learned IPs with TTLs, consumed by ``toFQDNs`` rules.
+
+Upstream learns names from its DNS proxy (it sits on port 53 via an L7
+redirect and observes responses); this framework exposes the same cache
+with a programmatic ``observe()`` feed — the AF_XDP shim or any resolver
+integration calls it with (name, ips, ttl). Learned IPs materialize as
+CIDR identities exactly like ``toCIDR`` peers, so the datapath needs no
+FQDN awareness at all (same as upstream, where toFQDNs compiles down to
+ipcache entries + selector identities).
+
+Pattern semantics mirror upstream's ``matchPattern``: ``*`` matches any
+run of DNS-label characters ``[-a-zA-Z0-9.]*`` (yes, dots too — upstream's
+matchpattern.go converts ``*`` to ``.*`` over the whole name); matching is
+case-insensitive on normalized names (lowercase, trailing dot stripped).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Sequence, Tuple
+
+
+def normalize_name(name: str) -> str:
+    return name.strip().lower().rstrip(".")
+
+
+@dataclass(frozen=True)
+class FQDNSelector:
+    """One toFQDNs entry: matchName (exact) or matchPattern (glob)."""
+    match_name: str = ""
+    match_pattern: str = ""
+
+    def __post_init__(self):
+        if bool(self.match_name) == bool(self.match_pattern):
+            raise ValueError(
+                "toFQDNs entry needs exactly one of matchName/matchPattern")
+        object.__setattr__(self, "match_name",
+                           normalize_name(self.match_name))
+        object.__setattr__(self, "match_pattern",
+                           normalize_name(self.match_pattern))
+        if self.match_pattern:
+            pat = "".join(
+                "[-a-zA-Z0-9.]*" if ch == "*" else re.escape(ch)
+                for ch in self.match_pattern)
+            object.__setattr__(self, "_compiled", re.compile(f"^{pat}$"))
+
+    def matches(self, name: str) -> bool:
+        name = normalize_name(name)
+        if self.match_name:
+            return name == self.match_name
+        return self._compiled.match(name) is not None
+
+
+class FQDNCache:
+    """name → {ip: expiry}. Thread-safe; observers fire on any change that
+    can affect policy (new IP learned, IP expired/flushed)."""
+
+    def __init__(self, min_ttl: int = 0, clock: Callable[[], float] = None):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, Dict[str, int]] = {}
+        self._observers: List[Callable[[], None]] = []
+        # upstream tofqdns-min-ttl: clamp tiny TTLs so churn-happy records
+        # don't thrash policy recomputation
+        self.min_ttl = min_ttl
+        # clock used when callers (rule materialization) don't pass ``now``;
+        # tests override with a synthetic clock
+        import time
+        self.clock = clock or time.time
+
+    def add_observer(self, obs: Callable[[], None]) -> None:
+        self._observers.append(obs)
+
+    def _notify(self):
+        for obs in list(self._observers):
+            obs()
+
+    def observe(self, name: str, ips: Sequence[str], ttl: int,
+                now: int) -> bool:
+        """Record a DNS answer. Returns True (and notifies) iff a new IP was
+        learned — TTL refreshes alone don't need a policy recompute."""
+        if not ips:
+            return False  # NXDOMAIN/empty answers must not create ghost names
+        name = normalize_name(name)
+        expiry = now + max(int(ttl), self.min_ttl)
+        changed = False
+        with self._lock:
+            ent = self._entries.setdefault(name, {})
+            for ip in ips:
+                prev = ent.get(ip)
+                if prev is None or prev <= now:
+                    # new OR expired-but-not-yet-GC'd: either way the
+                    # materialized policy may lack this IP → recompute
+                    changed = True
+                ent[ip] = max(prev or 0, expiry)
+        if changed:
+            self._notify()
+        return changed
+
+    def expire(self, now: int) -> int:
+        """GC expired IPs (upstream: fqdn cache GC controller). Notifies if
+        anything was removed (policy must drop the identities)."""
+        removed = 0
+        with self._lock:
+            for name in list(self._entries):
+                ent = self._entries[name]
+                dead = [ip for ip, exp in ent.items() if exp <= now]
+                for ip in dead:
+                    del ent[ip]
+                removed += len(dead)
+                if not ent:
+                    del self._entries[name]
+        if removed:
+            self._notify()
+        return removed
+
+    def lookup_selector(self, sel: FQDNSelector,
+                        now: int = None) -> List[str]:
+        """All live IPs whose name matches the selector (sorted)."""
+        if now is None:
+            now = int(self.clock())
+        out = set()
+        with self._lock:
+            for name, ent in self._entries.items():
+                if sel.matches(name):
+                    out.update(ip for ip, exp in ent.items() if exp > now)
+        return sorted(out)
+
+    def names(self) -> List[Tuple[str, Dict[str, int]]]:
+        with self._lock:
+            return sorted((n, dict(e)) for n, e in self._entries.items())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(e) for e in self._entries.values())
+
+    # -- checkpoint (upstream persists the DNS cache for FQDN policy) -------
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {"entries": {n: dict(e)
+                                for n, e in self._entries.items()}}
+
+    def restore_state(self, state: Dict) -> None:
+        with self._lock:
+            self._entries = {n: dict(e)
+                             for n, e in state.get("entries", {}).items()}
+        self._notify()

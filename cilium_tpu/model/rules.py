@@ -9,9 +9,10 @@ written for upstream Cilium ingest unchanged (SURVEY.md §2: "Keep schema
 ~verbatim (JSON-compatible) for rule ingestion").
 
 Out of scope v1 (parsed → rejected with a clear error rather than silently
-ignored): ``toFQDNs``, ``fromRequires``/``toRequires``, L7 kafka/dns.
+ignored): ``fromRequires``/``toRequires``, L7 kafka/dns.
 ``toServices`` is accepted and resolved through a host-side service registry
-(BASELINE config 3).
+(BASELINE config 3). ``toFQDNs`` is accepted and resolved through the DNS
+cache (``model/fqdn.py``): learned IPs materialize as CIDR identities.
 """
 
 from __future__ import annotations
@@ -132,10 +133,12 @@ class PeerSpec:
     cidrs: Tuple[CIDRSelector, ...] = ()
     entities: Tuple[str, ...] = ()
     services: Tuple[EndpointSelector, ...] = ()  # toServices k8s selectors
+    fqdns: Tuple["FQDNSelector", ...] = ()       # toFQDNs DNS-name selectors
 
     @property
     def is_empty(self) -> bool:
-        return not (self.endpoints or self.cidrs or self.entities or self.services)
+        return not (self.endpoints or self.cidrs or self.entities
+                    or self.services or self.fqdns)
 
 
 @dataclass(frozen=True)
@@ -179,7 +182,6 @@ class Rule:
 # Parsing
 # --------------------------------------------------------------------------- #
 _UNSUPPORTED_BLOCK_KEYS = {
-    "toFQDNs": "toFQDNs (FQDN policy) is out of scope v1",
     "fromRequires": "fromRequires is out of scope v1",
     "toRequires": "toRequires is out of scope v1",
 }
@@ -264,6 +266,24 @@ def _parse_block(obj: Dict, direction: str, deny: bool) -> RuleBlock:
                 raise RuleParseError(
                     "toServices entry needs k8sService or k8sServiceSelector")
         services = tuple(svc_sels)
+    fqdns: Tuple = ()
+    if direction == "ingress" and obj.get("toFQDNs"):
+        raise RuleParseError("toFQDNs is egress-only")
+    if direction == "egress" and obj.get("toFQDNs"):
+        if deny:
+            # same restriction as upstream: FQDN peers are learn-as-you-go,
+            # a deny that appears only after a DNS answer would be unsound
+            raise RuleParseError("toFQDNs is not allowed in deny rules")
+        from cilium_tpu.model.fqdn import FQDNSelector
+        sels = []
+        for f in obj["toFQDNs"]:
+            try:
+                sels.append(FQDNSelector(
+                    match_name=f.get("matchName", ""),
+                    match_pattern=f.get("matchPattern", "")))
+            except ValueError as e:
+                raise RuleParseError(str(e)) from e
+        fqdns = tuple(sels)
     to_ports = tuple(_parse_port_rule(p) for p in obj.get("toPorts") or [])
     icmps: List[ICMPField] = []
     for icmp_rule in obj.get("icmps") or []:
@@ -282,7 +302,7 @@ def _parse_block(obj: Dict, direction: str, deny: bool) -> RuleBlock:
                 raise RuleParseError("deny rules cannot carry L7 rules")
     return RuleBlock(
         peer=PeerSpec(endpoints=endpoints, cidrs=tuple(cidrs),
-                      entities=entities, services=services),
+                      entities=entities, services=services, fqdns=fqdns),
         to_ports=to_ports,
         icmps=tuple(icmps),
     )

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from cilium_tpu.model.endpoint import Endpoint
+from cilium_tpu.model.fqdn import FQDNCache
 from cilium_tpu.model.identity import Identity, IdentityAllocator
 from cilium_tpu.model.ipcache import IPCache
 from cilium_tpu.model.labels import Labels
@@ -42,6 +43,7 @@ class PolicyContext:
     selector_cache: SelectorCache
     ipcache: IPCache
     services: ServiceRegistry = field(default_factory=ServiceRegistry)
+    fqdn_cache: FQDNCache = field(default_factory=FQDNCache)
     enforcement_mode: str = C.ENFORCEMENT_DEFAULT
     allow_localhost: bool = True
 
@@ -80,6 +82,7 @@ class _RuleResources:
     blocks: Dict[int, _BlockResources] = field(default_factory=dict)  # id(block)→res
     allocations: List[Tuple[Identity, str]] = field(default_factory=list)
     has_services: bool = False
+    has_fqdns: bool = False
 
 
 class Repository:
@@ -93,6 +96,7 @@ class Repository:
         self._revision = 1
         self._observers: List[Callable[[int], None]] = []
         ctx.services.add_observer(self._on_services_changed)
+        ctx.fqdn_cache.add_observer(self._on_fqdns_changed)
 
     # -- rule management ----------------------------------------------------
     @property
@@ -207,6 +211,19 @@ class Repository:
                     ctx.ipcache.upsert(prefix, ident.id)
                     res.allocations.append((ident, prefix))
                     selector_objs.append(cidr_selector(prefix))
+        for fq in peer.fqdns:
+            res.has_fqdns = True
+            # toFQDNs: every IP the DNS cache has learned for a matching
+            # name becomes a host-prefix CIDR peer (upstream: pkg/fqdn
+            # NameManager → ipcache CIDR identities). Names learned later
+            # re-materialize via the cache observer.
+            for ip in ctx.fqdn_cache.lookup_selector(fq):
+                prefix = normalize_prefix(
+                    f"{ip}/128" if ":" in ip else f"{ip}/32")
+                ident = ctx.allocator.allocate_cidr(prefix)
+                ctx.ipcache.upsert(prefix, ident.id)
+                res.allocations.append((ident, prefix))
+                selector_objs.append(cidr_selector(prefix))
         cached = [ctx.selector_cache.add_selector(s) for s in selector_objs]
         return _BlockResources(wildcard=wildcard, selectors=cached)
 
@@ -226,8 +243,22 @@ class Repository:
             changed = False
             for rule in self._rules:
                 res = self._resources.get(id(rule))
-                if res is None or not (res.has_services or any(
-                        b.peer.services for b in rule.egress + rule.egress_deny)):
+                if res is None or not res.has_services:
+                    continue
+                self._release(res)
+                self._resources[id(rule)] = self._materialize(rule)
+                changed = True
+            if changed:
+                self._bump()
+
+    def _on_fqdns_changed(self) -> None:
+        """DNS cache changed: re-materialize rules with toFQDNs (the DNS
+        proxy → NameManager → policy-recompute path in upstream pkg/fqdn)."""
+        with self._lock:
+            changed = False
+            for rule in self._rules:
+                res = self._resources.get(id(rule))
+                if res is None or not res.has_fqdns:
                     continue
                 self._release(res)
                 self._resources[id(rule)] = self._materialize(rule)

@@ -65,6 +65,9 @@ def save(engine: Engine, path: str) -> None:
         # stable rev-NAT ids must survive restarts: restored CT entries
         # reference them
         "rnat_state": engine.ctx.services.export_rnat_state(),
+        # DNS cache persists so toFQDNs identities survive a restart
+        # (upstream: fqdn cache persistence)
+        "dns_cache": engine.ctx.fqdn_cache.export_state(),
     }
     # write-then-rename so a crash never leaves a torn checkpoint
     fd, tmp = tempfile.mkstemp(dir=path, prefix=".state-")
@@ -107,6 +110,9 @@ def _rebuild_control_plane(state: Dict, ctx, repo,
     ctx.allocator.restore_state(state["identity_state"])
     if "rnat_state" in state:
         ctx.services.restore_rnat_state(state["rnat_state"])
+    if "dns_cache" in state:
+        # before rules: toFQDNs materialization reads the cache
+        ctx.fqdn_cache.restore_state(state["dns_cache"])
     for svc in state.get("services", []):
         ctx.services.upsert(Service(
             name=svc["name"], namespace=svc["namespace"],

@@ -137,6 +137,18 @@ class Engine:
             self._mark_dirty()
             return True
 
+    # -- FQDN policy (pkg/fqdn analog) -----------------------------------------
+    def observe_dns(self, name: str, ips: Sequence[str], ttl: int = 3600,
+                    now: Optional[int] = None) -> bool:
+        """Feed one DNS answer into the FQDN cache (the programmatic stand-in
+        for upstream's DNS-proxy observation path). Newly learned IPs
+        re-materialize toFQDNs rules → regeneration."""
+        if now is None:
+            # the cache's clock, not wall time: materialization filters
+            # expiries through fqdn_cache.clock, and the two must agree
+            now = int(self.ctx.fqdn_cache.clock())
+        return self.ctx.fqdn_cache.observe(name, ips, ttl, now)
+
     # -- services (pkg/service analog) -----------------------------------------
     def upsert_service(self, svc) -> None:
         """Add/replace a Service (frontends+backends program the LB tensors
@@ -236,6 +248,13 @@ class Engine:
         """Start the periodic controllers (sweep; more as they land)."""
         self.controllers.update("ct-gc", lambda: self.sweep(),
                                 interval=self.config.sweep_interval_s)
+        # expired DNS names must revoke their identities (upstream: fqdn
+        # cache GC controller); expire() notifies → re-materialize → regen
+        self.controllers.update(
+            "fqdn-gc",
+            lambda: self.ctx.fqdn_cache.expire(
+                int(self.ctx.fqdn_cache.clock())),
+            interval=self.config.sweep_interval_s)
 
     def stop(self) -> None:
         self.controllers.stop_all()

@@ -1,0 +1,209 @@
+"""FQDN policy (pkg/fqdn analog): selector matching, cache TTL semantics,
+toFQDNs materialization into CIDR identities, learn/expire → policy
+recompute, datapath verdicts, checkpoint persistence."""
+
+import pytest
+
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.model.fqdn import FQDNCache, FQDNSelector
+from cilium_tpu.model.rules import RuleParseError, parse_rules
+from cilium_tpu.runtime.checkpoint import restore, save
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import PacketRecord
+
+
+class TestSelector:
+    def test_match_name(self):
+        s = FQDNSelector(match_name="API.example.com.")
+        assert s.matches("api.example.com")
+        assert s.matches("api.EXAMPLE.com.")
+        assert not s.matches("xapi.example.com")
+        assert not s.matches("example.com")
+
+    def test_match_pattern(self):
+        s = FQDNSelector(match_pattern="*.example.com")
+        assert s.matches("api.example.com")
+        assert s.matches("a.b.example.com")  # '*' spans dots (upstream)
+        assert not s.matches("example.com")
+        assert not s.matches("api.example.org")
+
+    def test_pattern_middle_star(self):
+        s = FQDNSelector(match_pattern="api-*.prod.svc")
+        assert s.matches("api-1.prod.svc")
+        assert not s.matches("web-1.prod.svc")
+
+    def test_exactly_one_of(self):
+        with pytest.raises(ValueError):
+            FQDNSelector()
+        with pytest.raises(ValueError):
+            FQDNSelector(match_name="a.com", match_pattern="*.com")
+
+
+class TestCache:
+    def test_observe_and_lookup(self):
+        c = FQDNCache()
+        assert c.observe("api.example.com", ["1.2.3.4"], ttl=60, now=100)
+        # TTL refresh alone: no change notification needed
+        assert not c.observe("api.example.com", ["1.2.3.4"], ttl=60, now=110)
+        assert c.observe("api.example.com", ["1.2.3.5"], ttl=60, now=110)
+        sel = FQDNSelector(match_name="api.example.com")
+        assert c.lookup_selector(sel, now=120) == ["1.2.3.4", "1.2.3.5"]
+        # expired IPs filtered from lookup even before GC
+        assert c.lookup_selector(sel, now=1000) == []
+
+    def test_expire_notifies(self):
+        c = FQDNCache()
+        events = []
+        c.add_observer(lambda: events.append(1))
+        c.observe("a.com", ["9.9.9.9"], ttl=50, now=0)
+        assert len(events) == 1
+        assert c.expire(now=10) == 0
+        assert c.expire(now=60) == 1
+        assert len(events) == 2
+        assert len(c) == 0
+
+    def test_relearn_after_expiry_notifies(self):
+        c = FQDNCache()
+        events = []
+        c.observe("a.com", ["9.9.9.9"], ttl=50, now=0)
+        c.add_observer(lambda: events.append(1))
+        # expired but not GC'd, then refreshed: policy may lack the IP
+        assert c.observe("a.com", ["9.9.9.9"], ttl=50, now=100)
+        assert len(events) == 1
+
+    def test_min_ttl(self):
+        c = FQDNCache(min_ttl=300)
+        c.observe("a.com", ["1.1.1.1"], ttl=1, now=0)
+        assert c.lookup_selector(FQDNSelector(match_name="a.com"),
+                                 now=200) == ["1.1.1.1"]
+
+
+class TestRuleParsing:
+    def test_tofqdns_parses(self):
+        [r] = parse_rules([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toFQDNs": [{"matchName": "api.example.com"},
+                                    {"matchPattern": "*.cdn.net"}],
+                        "toPorts": [{"ports": [{"port": "443",
+                                                "protocol": "TCP"}]}]}],
+        }])
+        assert len(r.egress[0].peer.fqdns) == 2
+
+    def test_tofqdns_ingress_rejected(self):
+        with pytest.raises(RuleParseError):
+            parse_rules([{
+                "endpointSelector": {},
+                "ingress": [{"toFQDNs": [{"matchName": "a.com"}]}],
+            }])
+
+    def test_tofqdns_deny_rejected(self):
+        with pytest.raises(RuleParseError):
+            parse_rules([{
+                "endpointSelector": {},
+                "egressDeny": [{"toFQDNs": [{"matchName": "a.com"}]}],
+            }])
+
+
+FQDN_POLICY = [{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toFQDNs": [{"matchName": "api.example.com"}],
+                "toPorts": [{"ports": [{"port": "443",
+                                        "protocol": "TCP"}]}]}],
+}]
+
+
+def _engine(policy=FQDN_POLICY):
+    """Engine with a test-controlled FQDN clock: rule materialization reads
+    the cache through ``fqdn_cache.clock``, so tests that use synthetic
+    ``now`` values must drive that clock too."""
+    eng = Engine(DaemonConfig(ct_capacity=4096, auto_regen=False))
+    clock = {"t": 100}
+    eng.ctx.fqdn_cache.clock = lambda: clock["t"]
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+    eng.apply_policy(policy)
+    return eng, clock
+
+
+def _pkt(dst, dport=443):
+    s16, _ = parse_addr("192.168.1.10")
+    d16, _ = parse_addr(dst)
+    return PacketRecord(s16, d16, 40000, dport, C.PROTO_TCP, C.TCP_SYN,
+                        False, 1, C.DIR_EGRESS)
+
+
+class TestEndToEnd:
+    def test_learn_allow_expire_deny(self):
+        eng, clock = _engine()
+        # before any DNS answer: default-deny (enforced egress, no peer)
+        out = eng.classify(batch_from_records(
+            [_pkt("20.1.2.3")], eng.active.snapshot.ep_slot_of), now=100)
+        assert not bool(out["allow"][0])
+        assert int(out["reason"][0]) == C.DropReason.POLICY
+
+        # DNS answer learned → rule re-materializes → traffic allowed
+        assert eng.observe_dns("api.example.com", ["20.1.2.3"], ttl=600,
+                               now=100)
+        out = eng.classify(batch_from_records(
+            [_pkt("20.1.2.3", dport=443)], eng.active.snapshot.ep_slot_of),
+            now=101)
+        assert bool(out["allow"][0])
+        # but only on the allowed port
+        out = eng.classify(batch_from_records(
+            [_pkt("20.1.2.3", dport=80)], eng.active.snapshot.ep_slot_of),
+            now=102)
+        assert not bool(out["allow"][0])
+
+        # TTL expiry + GC → identity revoked → NEW flows denied again
+        clock["t"] = 1000
+        eng.ctx.fqdn_cache.expire(now=1000)
+        out = eng.classify(batch_from_records(
+            [_pkt("20.1.2.3", dport=443)], eng.active.snapshot.ep_slot_of),
+            now=1001)
+        assert not bool(out["allow"][0])
+
+    def test_pattern_learns_multiple_names(self):
+        eng, clock = _engine(policy=[{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toFQDNs": [{"matchPattern": "*.cdn.net"}]}],
+        }])
+        eng.observe_dns("a.cdn.net", ["30.0.0.1"], now=100)
+        eng.observe_dns("b.cdn.net", ["30.0.0.2"], now=100)
+        eng.observe_dns("evil.org", ["30.0.0.3"], now=100)
+        slot_of = eng.active.snapshot.ep_slot_of
+        out = eng.classify(batch_from_records(
+            [_pkt("30.0.0.1"), _pkt("30.0.0.2"), _pkt("30.0.0.3")],
+            slot_of), now=101)
+        assert bool(out["allow"][0]) and bool(out["allow"][1])
+        assert not bool(out["allow"][2])
+
+    def test_checkpoint_persists_dns_cache(self, tmp_path):
+        eng, clock = _engine()
+        # expiry (= now + ttl) must beat the REAL clock: the restored engine
+        # materializes rules with wall time
+        eng.observe_dns("api.example.com", ["20.1.2.3"], ttl=10**10, now=100)
+        eng.active
+        save(eng, str(tmp_path / "s"))
+        eng2 = Engine(DaemonConfig(ct_capacity=4096, auto_regen=False))
+        restore(eng2, str(tmp_path / "s"))
+        assert len(eng2.ctx.fqdn_cache) == 1
+        out = eng2.classify(batch_from_records(
+            [_pkt("20.1.2.3")], eng2.active.snapshot.ep_slot_of), now=105)
+        assert bool(out["allow"][0])
+
+    def test_cli_fqdn_cache(self, tmp_path, capsys):
+        from cilium_tpu.cli.main import main as cli_main
+        import json
+        eng, clock = _engine()
+        eng.observe_dns("api.example.com", ["20.1.2.3"], ttl=500, now=100)
+        eng.active
+        save(eng, str(tmp_path / "s"))
+        rc = cli_main(["fqdn", "cache", "--state-dir", str(tmp_path / "s"),
+                       "-o", "json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc[0]["name"] == "api.example.com"
+        assert "20.1.2.3" in doc[0]["ips"]

@@ -119,7 +119,7 @@ class TestRules:
     def test_rejects_out_of_scope(self):
         with pytest.raises(RuleParseError):
             parse_rule({"endpointSelector": {},
-                        "egress": [{"toFQDNs": [{"matchName": "x.com"}]}]})
+                        "egress": [{"toRequires": [{}]}]})
         with pytest.raises(RuleParseError):
             parse_rule({"endpointSelector": {},
                         "ingressDeny": [{"toPorts": [{
