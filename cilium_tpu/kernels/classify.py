@@ -182,10 +182,18 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
 
 
 def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
-                     donate_ct: bool = True):
+                     donate_ct: bool = True, packed: bool = False):
     """jit-compiled classify step. CT buffers are donated (in-place update,
-    no double allocation); re-traces only when array shapes change."""
+    no double allocation); re-traces only when array shapes change.
+
+    ``packed=True``: the batch argument is the single contiguous uint32 wire
+    array (kernels/records.pack_batch) — one host→device transfer instead of
+    twelve; unpacking happens on device and fuses into the pipeline. This is
+    the transfer-bound production path; the dict path stays for tests."""
     def fn(tensors, ct, batch, now, world_index):
+        if packed:
+            from cilium_tpu.kernels.records import unpack_batch_jnp
+            batch = unpack_batch_jnp(batch)
         return classify_step(tensors, ct, batch, now, world_index,
                              probe_depth=probe_depth, v4_only=v4_only)
     return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

@@ -81,14 +81,24 @@ class FQDNCache:
                 now: int) -> bool:
         """Record a DNS answer. Returns True (and notifies) iff a new IP was
         learned — TTL refreshes alone don't need a policy recompute."""
-        if not ips:
+        import ipaddress
+        valid_ips = []
+        for ip in ips:
+            try:
+                valid_ips.append(str(ipaddress.ip_address(ip)))
+            except ValueError:
+                # a garbage answer must not poison the cache: materialization
+                # would crash on it inside the change observer and wedge all
+                # toFQDNs policy until the TTL expired
+                continue
+        if not valid_ips:
             return False  # NXDOMAIN/empty answers must not create ghost names
         name = normalize_name(name)
         expiry = now + max(int(ttl), self.min_ttl)
         changed = False
         with self._lock:
             ent = self._entries.setdefault(name, {})
-            for ip in ips:
+            for ip in valid_ips:
                 prev = ent.get(ip)
                 if prev is None or prev <= now:
                     # new OR expired-but-not-yet-GC'd: either way the

@@ -279,8 +279,8 @@ def _parse_block(obj: Dict, direction: str, deny: bool) -> RuleBlock:
         for f in obj["toFQDNs"]:
             try:
                 sels.append(FQDNSelector(
-                    match_name=f.get("matchName", ""),
-                    match_pattern=f.get("matchPattern", "")))
+                    match_name=f.get("matchName") or "",
+                    match_pattern=f.get("matchPattern") or ""))
             except ValueError as e:
                 raise RuleParseError(str(e)) from e
         fqdns = tuple(sels)

@@ -74,6 +74,25 @@ class TestCache:
         assert c.observe("a.com", ["9.9.9.9"], ttl=50, now=100)
         assert len(events) == 1
 
+    def test_garbage_ips_skipped(self):
+        """Unparseable IPs from a resolver must not poison the cache (they
+        would crash rule materialization inside the change observer)."""
+        c = FQDNCache()
+        assert not c.observe("a.com", ["999.999.1.1", "nonsense"],
+                             ttl=60, now=0)
+        assert len(c) == 0
+        assert c.observe("a.com", ["999.999.1.1", "1.2.3.4"], ttl=60, now=0)
+        assert c.lookup_selector(FQDNSelector(match_name="a.com"),
+                                 now=10) == ["1.2.3.4"]
+
+    def test_null_matchname_rejected_cleanly(self):
+        with pytest.raises(RuleParseError):
+            parse_rules([{
+                "endpointSelector": {},
+                "egress": [{"toFQDNs": [{"matchName": None,
+                                         "matchPattern": None}]}],
+            }])
+
     def test_min_ttl(self):
         c = FQDNCache(min_ttl=300)
         c.observe("a.com", ["1.1.1.1"], ttl=1, now=0)
