@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from cilium_tpu.utils import constants as C
@@ -78,6 +79,28 @@ def register(sub: "argparse._SubParsersAction") -> None:
                     help="wall-clock for liveness (default: max created)")
     cl.add_argument("--limit", type=int, default=64)
     cl.set_defaults(func=_cmd_ct_list)
+
+    p = sub.add_parser(
+        "monitor", help="flow log viewer (cilium monitor / hubble observe)")
+    p.add_argument("--flowlog-path", required=True,
+                   help="JSONL sink written by the engine "
+                        "(DaemonConfig.flowlog_path)")
+    p.add_argument("--last", type=int, default=50)
+    p.add_argument("--verdict", choices=["FORWARDED", "DROPPED"])
+    p.add_argument("--endpoint", type=int)
+    p.add_argument("--ip", help="match src or dst IP")
+    p.add_argument("--port", type=int, help="match src or dst port")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep reading appended records (Ctrl-C to stop)")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="text")
+    p.set_defaults(func=_cmd_monitor)
+
+    p = sub.add_parser("metrics", help="print the Prometheus text file the "
+                                       "engine exports")
+    p.add_argument("--metrics-path", required=True,
+                   help="DaemonConfig.metrics_path file")
+    p.set_defaults(func=_cmd_metrics)
 
     p = sub.add_parser(
         "map", help="compiled policy-map inspection (cilium bpf policy get)")
@@ -363,6 +386,84 @@ def _cmd_ct_list(args) -> int:
                   f"ttl={e['expires_in']}s fwd={e['pkts_fwd']} "
                   f"rev={e['pkts_rev']}{rn}")
     return _emit(args, doc, text)
+
+
+def _flow_matches(r: dict, args) -> bool:
+    if args.verdict and r.get("verdict") != args.verdict:
+        return False
+    if args.endpoint is not None and r.get("endpoint_id") != args.endpoint:
+        return False
+    if args.ip and args.ip not in (r.get("src_ip"), r.get("dst_ip")):
+        return False
+    if args.port is not None and args.port not in (r.get("src_port"),
+                                                   r.get("dst_port")):
+        return False
+    return True
+
+
+def _flow_line(r: dict) -> str:
+    mark = "->" if r.get("verdict") == "FORWARDED" else "xx"
+    why = ("" if r.get("verdict") == "FORWARDED"
+           else f" ({r.get('drop_reason_desc')})")
+    return (f"[{r.get('time')}] ep{r.get('endpoint_id')} "
+            f"{r.get('direction'):<7} {r.get('proto'):<5} "
+            f"{r.get('src_ip')}:{r.get('src_port')} {mark} "
+            f"{r.get('dst_ip')}:{r.get('dst_port')} "
+            f"{r.get('ct_state'):<11} {r.get('verdict')}{why}")
+
+
+def _cmd_monitor(args) -> int:
+    import time as _time
+    if not os.path.exists(args.flowlog_path):
+        print(f"no flow log at {args.flowlog_path}", file=sys.stderr)
+        return 1
+
+    def emit(records):
+        if args.output == "json":
+            for r in records:
+                print(json.dumps(r), flush=args.follow)
+        else:
+            for r in records:
+                print(_flow_line(r), flush=args.follow)
+
+    with open(args.flowlog_path) as f:
+        records = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if _flow_matches(r, args):
+                records.append(r)
+        emit(records[-args.last:])
+        if not args.follow:
+            return 0
+        try:
+            while True:
+                line = f.readline()
+                if not line:
+                    _time.sleep(0.2)
+                    continue
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if _flow_matches(r, args):
+                    emit([r])
+        except KeyboardInterrupt:
+            return 0
+
+
+def _cmd_metrics(args) -> int:
+    if not os.path.exists(args.metrics_path):
+        print(f"no metrics file at {args.metrics_path}", file=sys.stderr)
+        return 1
+    with open(args.metrics_path) as f:
+        sys.stdout.write(f.read())
+    return 0
 
 
 def _cmd_map_get(args) -> int:

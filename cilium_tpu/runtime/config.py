@@ -41,6 +41,9 @@ class DaemonConfig:
     # --- observability ---
     flowlog_capacity: int = 16384
     flowlog_mode: str = "drops"    # all | drops | none
+    flowlog_path: str = ""         # JSONL sink ("" = in-memory ring only)
+    metrics_path: str = ""         # Prometheus text file ("" = disabled)
+    obs_flush_interval_s: float = 5.0
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:

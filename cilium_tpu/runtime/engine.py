@@ -74,7 +74,8 @@ class Engine:
 
         self.metrics = Metrics()
         self.flowlog = FlowLog(self.config.flowlog_capacity,
-                               self.config.flowlog_mode)
+                               self.config.flowlog_mode,
+                               sink_path=self.config.flowlog_path or None)
         self.controllers = ControllerManager()
 
         self._lock = threading.RLock()
@@ -90,6 +91,10 @@ class Engine:
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
                                       sync=not self.config.auto_regen)
+        # health prober source address → reserved health identity
+        # (cilium-health analog; upstream allocates health endpoint IPs)
+        self.ctx.ipcache.upsert(f"{C.HEALTH_PROBE_IP}/32", C.IDENTITY_HEALTH)
+
         self.repo.add_observer(lambda rev: self._regen_trigger())
         self.ctx.ipcache.add_observer(self._mark_dirty)
         # LB-only service changes (no toServices rule referencing them) still
@@ -255,6 +260,67 @@ class Engine:
             lambda: self.ctx.fqdn_cache.expire(
                 int(self.ctx.fqdn_cache.clock())),
             interval=self.config.sweep_interval_s)
+        if self.config.flowlog_path or self.config.metrics_path:
+            self.controllers.update(
+                "obs-flush", self.flush_observability,
+                interval=self.config.obs_flush_interval_s)
+
+    def health_probe(self, now: Optional[int] = None) -> Dict[int, Dict]:
+        """Datapath health check (cilium-health analog): classify one ICMP
+        echo probe from the reserved health identity to every endpoint with
+        an IP, through the real device path. Returns
+        {ep_id: {reachable, reason, ct_state}}; a probe's verdict follows
+        policy exactly like real traffic (an endpoint whose ingress denies
+        the health identity reports unreachable — same as upstream when
+        health checks are not whitelisted)."""
+        from oracle import PacketRecord
+        from cilium_tpu.kernels.records import batch_from_records
+        from cilium_tpu.utils.ip import parse_addr
+
+        if now is None:
+            now = int(time.time())
+        src16, _ = parse_addr(C.HEALTH_PROBE_IP)
+        eps = [ep for ep in sorted(self.endpoints.values(),
+                                   key=lambda e: e.ep_id) if ep.ips]
+        if not eps:
+            return {}
+        recs = []
+        for ep in eps:
+            dst16, v6 = parse_addr(ep.ips[0])
+            recs.append(PacketRecord(
+                src16, dst16, 0, C.ICMP_ECHO_REQUEST,
+                C.PROTO_ICMP6 if v6 else C.PROTO_ICMP, 0, v6,
+                ep.ep_id, C.DIR_INGRESS))
+        out = self.classify(
+            batch_from_records(recs, self.active.snapshot.ep_slot_of),
+            now=now)
+        report = {}
+        for i, ep in enumerate(eps):
+            report[ep.ep_id] = {
+                "reachable": bool(out["allow"][i]),
+                "reason": C.DropReason(int(out["reason"][i])).name,
+                "ct_state": C.CTStatus(int(out["status"][i])).name,
+            }
+        self.metrics.set_gauge(
+            "health_reachable_endpoints",
+            sum(1 for r in report.values() if r["reachable"]))
+        return report
+
+    def flush_observability(self) -> None:
+        """Flush the flow-log sink and write the Prometheus text file (the
+        hubble-export + node-exporter-textfile analog). Also callable
+        directly for synchronous export."""
+        if self.config.flowlog_path:
+            self.flowlog.flush_sink()
+        if self.config.metrics_path:
+            import os
+            import tempfile
+            d = os.path.dirname(self.config.metrics_path) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".metrics-")
+            with os.fdopen(fd, "w") as f:
+                f.write(self.metrics.render_prometheus())
+            os.replace(tmp, self.config.metrics_path)
 
     def stop(self) -> None:
         self.controllers.stop_all()

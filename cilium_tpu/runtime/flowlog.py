@@ -1,27 +1,35 @@
 """Flow log ring (Hubble-lite: SURVEY.md §2 "Minimal analog: flow log with
 identity/verdict annotation"). Fixed-capacity host ring buffer of flow
-records appended per batch; renderable as JSON lines for the CLI.
+records appended per batch; renderable as JSON lines for the CLI, with an
+optional JSONL file sink (the ``hubble export`` analog) that the
+``cilium-tpu monitor`` command reads.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
 from cilium_tpu.utils import constants as C
 from cilium_tpu.utils.ip import addr_to_str, words_to_addr
 
+SINK_ROTATE_BYTES = 64 << 20      # rotate the JSONL sink at 64MB (keep .1)
+
 
 class FlowLog:
-    def __init__(self, capacity: int = 16384, mode: str = "drops"):
+    def __init__(self, capacity: int = 16384, mode: str = "drops",
+                 sink_path: Optional[str] = None):
         self.capacity = capacity
         self.mode = mode
+        self.sink_path = sink_path
         self._lock = threading.Lock()
         self._ring: List[Dict] = []
         self._next = 0
+        self._sink_buf: List[str] = []
         self.total_seen = 0
 
     def append_batch(self, batch: Dict[str, np.ndarray],
@@ -70,13 +78,41 @@ class FlowLog:
                 else:
                     self._ring[self._next] = rec
                 self._next = (self._next + 1) % self.capacity
+            if self.sink_path is not None:
+                self._sink_buf.extend(json.dumps(r) for r in records)
 
-    def tail(self, n: int = 100) -> List[Dict]:
+    def flush_sink(self) -> int:
+        """Append buffered records to the JSONL sink (called by the
+        observability controller; cheap no-op when nothing is pending).
+        Rotates to ``<path>.1`` past SINK_ROTATE_BYTES."""
+        with self._lock:
+            if not self._sink_buf or self.sink_path is None:
+                return 0
+            lines, self._sink_buf = self._sink_buf, []
+        d = os.path.dirname(self.sink_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        try:
+            if (os.path.exists(self.sink_path)
+                    and os.path.getsize(self.sink_path) > SINK_ROTATE_BYTES):
+                os.replace(self.sink_path, self.sink_path + ".1")
+        except OSError:
+            pass
+        with open(self.sink_path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+        return len(lines)
+
+    def tail(self, n: int = 100, **filters) -> List[Dict]:
+        """Last ``n`` records, newest last. ``filters`` narrow by exact
+        field match (verdict=, endpoint_id=, src_ip=, dst_port=, ...)."""
         with self._lock:
             if len(self._ring) < self.capacity:
                 items = self._ring[:]
             else:
                 items = self._ring[self._next:] + self._ring[:self._next]
+        if filters:
+            items = [r for r in items
+                     if all(r.get(k) == v for k, v in filters.items())]
         return items[-n:]
 
     def to_jsonl(self, n: int = 100) -> str:

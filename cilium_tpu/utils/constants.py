@@ -180,6 +180,14 @@ ENFORCEMENT_NEVER = "never"
 ENFORCEMENT_MODES = (ENFORCEMENT_DEFAULT, ENFORCEMENT_ALWAYS, ENFORCEMENT_NEVER)
 
 # --------------------------------------------------------------------------- #
+# Health probing (cilium-health analog): the node's health prober sources
+# probes from this link-local address, mapped to the reserved health
+# identity in the ipcache at engine startup.
+# --------------------------------------------------------------------------- #
+HEALTH_PROBE_IP = "169.254.254.254"
+ICMP_ECHO_REQUEST = 8
+
+# --------------------------------------------------------------------------- #
 # L7-lite (config 4): tokenized HTTP method/path-prefix matching
 # --------------------------------------------------------------------------- #
 HTTP_METHODS = (

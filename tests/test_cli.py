@@ -148,6 +148,65 @@ class TestCLI:
         assert rc == 1
 
 
+class TestObservability:
+    @pytest.fixture()
+    def obs_engine(self, tmp_path):
+        eng = Engine(DaemonConfig(
+            ct_capacity=4096, auto_regen=False, flowlog_mode="all",
+            flowlog_path=str(tmp_path / "flows.jsonl"),
+            metrics_path=str(tmp_path / "metrics.prom")))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        s16, _ = parse_addr("192.168.1.10")
+        pkts = [
+            PacketRecord(s16, parse_addr("10.1.2.3")[0], 40000, 443,
+                         C.PROTO_TCP, C.TCP_SYN, False, 1, C.DIR_EGRESS),
+            PacketRecord(s16, parse_addr("10.1.2.4")[0], 40001, 80,
+                         C.PROTO_TCP, C.TCP_SYN, False, 1, C.DIR_EGRESS),
+        ]
+        eng.classify(batch_from_records(pkts, eng.active.snapshot.ep_slot_of),
+                     now=100)
+        eng.flush_observability()
+        return eng, tmp_path
+
+    def test_flowlog_sink_and_monitor(self, obs_engine, capsys):
+        eng, tmp_path = obs_engine
+        path = str(tmp_path / "flows.jsonl")
+        assert sum(1 for _ in open(path)) == 2
+        rc = cli_main(["monitor", "--flowlog-path", path, "-o", "json"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert rc == 0 and len(out) == 2
+        recs = [json.loads(x) for x in out]
+        assert {r["verdict"] for r in recs} == {"FORWARDED", "DROPPED"}
+        # filters
+        rc = cli_main(["monitor", "--flowlog-path", path,
+                       "--verdict", "DROPPED", "-o", "json"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1 and json.loads(out[0])["dst_port"] == 80
+        rc = cli_main(["monitor", "--flowlog-path", path,
+                       "--ip", "10.1.2.3"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1 and "FORWARDED" in out[0]
+
+    def test_flowlog_ring_filters(self, obs_engine):
+        eng, _ = obs_engine
+        assert len(eng.flowlog.tail(verdict="DROPPED")) == 1
+        assert len(eng.flowlog.tail(verdict="FORWARDED")) == 1
+
+    def test_metrics_file_and_cli(self, obs_engine, capsys):
+        eng, tmp_path = obs_engine
+        path = str(tmp_path / "metrics.prom")
+        rc = cli_main(["metrics", "--metrics-path", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ciliumtpu_packets_total 2" in out
+        assert 'reason="POLICY"' in out
+
+    def test_missing_files_error(self, capsys):
+        assert cli_main(["monitor", "--flowlog-path", "/nope.jsonl"]) == 1
+        assert cli_main(["metrics", "--metrics-path", "/nope.prom"]) == 1
+
+
 class TestEnforcementModePersistence:
     def test_trace_uses_checkpointed_enforcement(self, tmp_path, capsys):
         """'always' mode must survive into the CLI: an unselected endpoint is

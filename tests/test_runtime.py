@@ -228,6 +228,32 @@ class TestEngineServices:
         assert int(out2["rnat_sport"][0]) == 443
 
 
+class TestHealth:
+    def test_health_probe(self):
+        eng = small_engine()
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.add_endpoint(["k8s:app=db"], ips=("192.168.1.20",), ep_id=2)
+        # web: unenforced ingress (no ingress rules) → reachable;
+        # db: enforced ingress that does NOT allow health → unreachable
+        eng.apply_policy(POLICY + [{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{"fromEndpoints": [{"matchLabels":
+                                            {"app": "web"}}]}],
+        }])
+        rep = eng.health_probe(now=100)
+        assert rep[1]["reachable"] is True
+        assert rep[2]["reachable"] is False
+        assert rep[2]["reason"] == "POLICY"
+        # whitelist health → reachable (the upstream remediation)
+        eng.apply_policy([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{"fromEntities": ["health"]}],
+        }])
+        rep = eng.health_probe(now=200)
+        assert rep[2]["reachable"] is True
+        assert eng.metrics.gauges["health_reachable_endpoints"] == 2
+
+
 class TestCheckpoint:
     def test_flows_survive_restart(self, tmp_path):
         eng = small_engine()
