@@ -338,11 +338,14 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     # loop — the shim does it in C++; transfer included, it is part of the
     # real pipeline). One packed width per config so a single jit serves.
     host_dicts = [gen(rng, batch) for _ in range(min(batches, 16))]
-    from cilium_tpu.kernels.records import PACK_WORDS
-    first = pack_batch(host_dicts[0])          # auto-detects the L7 block
-    has_l7 = first.shape[1] > PACK_WORDS
-    host_batches = [first] + [pack_batch(hb, l7=has_l7)
-                              for hb in host_dicts[1:]]
+    from cilium_tpu.utils import constants as C
+    # L7 presence must be decided across ALL pre-generated batches: deciding
+    # from the first alone silently drops later batches' http_path data
+    # (changing measured verdicts) whenever the first happens to be L7-free.
+    # (Same detection expression pack_batch uses, without packing twice.)
+    has_l7 = any(bool((hb["http_method"] != C.HTTP_METHOD_ANY).any()
+                      or hb["http_path"].any()) for hb in host_dicts)
+    host_batches = [pack_batch(hb, l7=has_l7) for hb in host_dicts]
 
     # warmup / compile
     now = 10_000

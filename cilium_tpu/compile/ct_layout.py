@@ -44,7 +44,9 @@ def make_ct_arrays(cfg: CTConfig) -> Dict[str, np.ndarray]:
         "flags": np.zeros((cap,), dtype=np.uint32),
         "pkts_fwd": np.zeros((cap,), dtype=np.uint32),
         "pkts_rev": np.zeros((cap,), dtype=np.uint32),
-        # service rev-NAT: frontend idx + 1 of the DNAT applied at create
-        # time, 0 = none (upstream: CtEntry.rev_nat_index)
+        # service rev-NAT: stable rev-NAT id + 1 of the DNAT applied at
+        # create time (see compile/lb.LBTables — stable ids are why stale CT
+        # entries fail closed instead of rewriting to another service's VIP),
+        # 0 = none (upstream: CtEntry.rev_nat_index)
         "rev_nat": np.zeros((cap,), dtype=np.uint32),
     }

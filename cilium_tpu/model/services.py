@@ -89,6 +89,10 @@ class ServiceRegistry:
         # otherwise rewrite replies to a NEW service's VIP).
         self._rnat_ids: Dict[Tuple[bytes, int, int], int] = {}
         self._next_rnat_id = 0
+        # Frontend (addr16, port, proto) → owning (namespace, name): the
+        # uniqueness index consulted at upsert time (O(frontends) per upsert,
+        # not a scan of every registered service).
+        self._fe_owner: Dict[Tuple[bytes, int, int], Tuple[str, str]] = {}
 
     def add_observer(self, obs: Callable[[], None]) -> None:
         self._observers.append(obs)
@@ -123,17 +127,57 @@ class ServiceRegistry:
                 (parse_addr(e["addr"])[0], e["port"], e["proto"]): e["id"]
                 for e in state["ids"]}
 
-    def upsert(self, svc: Service) -> None:
+    def upsert(self, svc: Service, validate: bool = True) -> None:
+        """Register/replace a service. With ``validate`` (the default),
+        frontend (VIP, port, proto) collisions with another service are
+        rejected synchronously — deferring to snapshot-compile time would let
+        the bad upsert poison every subsequent (auto-triggered) regeneration.
+        ``validate=False`` is for checkpoint restore, which must accept
+        whatever an older engine accepted (the conflict then surfaces at the
+        next regenerate, logged + counted by the engine)."""
+        from cilium_tpu.utils.ip import parse_addr
+        me = (svc.namespace, svc.name)
         with self._lock:
+            keys = [(parse_addr(fe.addr)[0], fe.port, fe.proto)
+                    for fe in svc.frontends]
+            if validate:
+                seen = set()
+                for key, fe in zip(keys, svc.frontends):
+                    if key in seen:
+                        raise ValueError(
+                            f"service {svc.namespace}/{svc.name} declares "
+                            f"frontend {fe.addr}:{fe.port}/{fe.proto} twice")
+                    seen.add(key)
+                    owner = self._fe_owner.get(key)
+                    if owner is not None and owner != me:
+                        raise ValueError(
+                            f"frontend {fe.addr}:{fe.port}/{fe.proto} of "
+                            f"service {svc.namespace}/{svc.name} conflicts "
+                            f"with existing service {owner[0]}/{owner[1]}")
+            old = self._services.get(me)
+            if old is not None:
+                for fe in old.frontends:
+                    k = (parse_addr(fe.addr)[0], fe.port, fe.proto)
+                    if self._fe_owner.get(k) == me:
+                        del self._fe_owner[k]
+            for key in keys:
+                self._fe_owner.setdefault(key, me)
             for fe in svc.frontends:
                 self.rnat_id(fe)      # allocate eagerly, deterministically
-            self._services[(svc.namespace, svc.name)] = svc
+            self._services[me] = svc
         for obs in list(self._observers):
             obs()
 
     def delete(self, namespace: str, name: str) -> bool:
+        from cilium_tpu.utils.ip import parse_addr
         with self._lock:
-            ok = self._services.pop((namespace, name), None) is not None
+            svc = self._services.pop((namespace, name), None)
+            ok = svc is not None
+            if ok:
+                for fe in svc.frontends:
+                    k = (parse_addr(fe.addr)[0], fe.port, fe.proto)
+                    if self._fe_owner.get(k) == (namespace, name):
+                        del self._fe_owner[k]
         if ok:
             for obs in list(self._observers):
                 obs()

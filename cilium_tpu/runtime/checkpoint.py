@@ -114,13 +114,17 @@ def _rebuild_control_plane(state: Dict, ctx, repo,
         # before rules: toFQDNs materialization reads the cache
         ctx.fqdn_cache.restore_state(state["dns_cache"])
     for svc in state.get("services", []):
+        # validate=False: restore must accept whatever the saving engine
+        # accepted (incl. pre-validation checkpoints with conflicting
+        # frontends) — a conflict then surfaces at the next regenerate.
         ctx.services.upsert(Service(
             name=svc["name"], namespace=svc["namespace"],
             backends=tuple(svc["backends"]),
             frontends=tuple(Frontend(**f)
                             for f in svc.get("frontends", [])),
             lb_backends=tuple(Backend(**b)
-                              for b in svc.get("lb_backends", []))))
+                              for b in svc.get("lb_backends", []))),
+            validate=False)
     for ep in state["endpoints"]:
         add_endpoint(ep)
     if state["rules"]:

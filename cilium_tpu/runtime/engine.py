@@ -16,6 +16,7 @@ re-populatable from agent state").
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -182,8 +183,12 @@ class Engine:
             try:
                 self.regenerate()
             except Exception:
-                # controller-style isolation; next classify retries
-                pass
+                # controller-style isolation; next classify retries — but
+                # surface it: a silently-failing regen means the device keeps
+                # serving stale policy until the underlying error is fixed.
+                logging.getLogger("cilium_tpu.engine").exception(
+                    "regeneration failed; device state is stale")
+                self.metrics.inc_counter("regen_failures_total")
 
     def regenerate(self, force: bool = False) -> CompiledSnapshot:
         """Compile current control-plane state and swap it in atomically."""

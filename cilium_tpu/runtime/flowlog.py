@@ -18,6 +18,7 @@ from cilium_tpu.utils import constants as C
 from cilium_tpu.utils.ip import addr_to_str, words_to_addr
 
 SINK_ROTATE_BYTES = 64 << 20      # rotate the JSONL sink at 64MB (keep .1)
+SINK_BUF_MAX = 65536              # cap pending sink lines (drop-oldest)
 
 
 class FlowLog:
@@ -30,6 +31,7 @@ class FlowLog:
         self._ring: List[Dict] = []
         self._next = 0
         self._sink_buf: List[str] = []
+        self.sink_dropped = 0          # lines shed when _sink_buf hit its cap
         self.total_seen = 0
 
     def append_batch(self, batch: Dict[str, np.ndarray],
@@ -80,6 +82,13 @@ class FlowLog:
                 self._next = (self._next + 1) % self.capacity
             if self.sink_path is not None:
                 self._sink_buf.extend(json.dumps(r) for r in records)
+                # Bound host memory if flush_sink isn't running (engine used
+                # without controllers, or drop storms outpacing the flush
+                # interval): shed oldest, count the shed.
+                excess = len(self._sink_buf) - SINK_BUF_MAX
+                if excess > 0:
+                    del self._sink_buf[:excess]
+                    self.sink_dropped += excess
 
     def flush_sink(self) -> int:
         """Append buffered records to the JSONL sink (called by the

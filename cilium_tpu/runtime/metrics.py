@@ -54,6 +54,7 @@ class Metrics:
         self.batches_total = 0
         self.spans: Dict[str, SpanStat] = {}
         self.gauges: Dict[str, float] = {}
+        self.counters: Dict[str, int] = {}
 
     def span(self, name: str) -> SpanStat:
         with self._lock:
@@ -72,6 +73,12 @@ class Metrics:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self.gauges[name] = value
+
+    def inc_counter(self, name: str, by: int = 1) -> None:
+        """Named host-side counter (upstream: errors/warnings metrics —
+        e.g. regeneration failures, sink drops)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
 
     # -- rendering -----------------------------------------------------------
     def render_prometheus(self) -> str:
@@ -98,6 +105,9 @@ class Metrics:
             lines.append(f"ciliumtpu_packets_total {self.packets_total}")
             lines.append("# TYPE ciliumtpu_batches_total counter")
             lines.append(f"ciliumtpu_batches_total {self.batches_total}")
+            for name, v in sorted(self.counters.items()):
+                lines.append(f"# TYPE ciliumtpu_{name} counter")
+                lines.append(f"ciliumtpu_{name} {v}")
             for name, g in sorted(self.gauges.items()):
                 lines.append(f"# TYPE ciliumtpu_{name} gauge")
                 lines.append(f"ciliumtpu_{name} {g}")

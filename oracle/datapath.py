@@ -98,7 +98,8 @@ class CTEntry:
     flags: int = 0                  # CT_FLAG_*
     pkts_fwd: int = 0
     pkts_rev: int = 0
-    rev_nat: int = 0                # frontend idx + 1 (0 = no service DNAT)
+    rev_nat: int = 0    # stable rev-NAT id + 1 (see compile/lb.LBTables);
+                        # 0 = no service DNAT
 
 
 def _tcp_lifetime(flags: int) -> int:

@@ -378,3 +378,64 @@ class TestLBParity:
             [v2] = o2.classify_batch_snapshot([p], now)
             assert v1 == v2, (i, v1, v2)
             now += 3
+
+
+# --------------------------------------------------------------------------- #
+# ServiceRegistry validation (frontend uniqueness is enforced at upsert time,
+# not deferred to snapshot compile where auto_regen would swallow it)
+# --------------------------------------------------------------------------- #
+class TestServiceRegistryValidation:
+    def test_conflicting_frontend_rejected(self):
+        from cilium_tpu.model.services import ServiceRegistry
+        reg = ServiceRegistry()
+        reg.upsert(Service(name="a", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),), lb_backends=(Backend("10.0.0.1", 8080),)))
+        with pytest.raises(ValueError, match="conflicts"):
+            reg.upsert(Service(name="b", namespace="ns", frontends=(
+                Frontend("172.20.0.10", 80),),
+                lb_backends=(Backend("10.0.0.2", 8080),)))
+        # different port on the same VIP is fine
+        reg.upsert(Service(name="b", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 81),), lb_backends=(Backend("10.0.0.2", 8080),)))
+
+    def test_self_update_keeps_frontend(self):
+        from cilium_tpu.model.services import ServiceRegistry
+        reg = ServiceRegistry()
+        svc = Service(name="a", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),), lb_backends=(Backend("10.0.0.1", 8080),))
+        reg.upsert(svc)
+        reg.upsert(svc)          # idempotent re-upsert of the owner
+
+    def test_duplicate_frontend_within_service_rejected(self):
+        from cilium_tpu.model.services import ServiceRegistry
+        reg = ServiceRegistry()
+        with pytest.raises(ValueError, match="twice"):
+            reg.upsert(Service(name="a", namespace="ns", frontends=(
+                Frontend("172.20.0.10", 80), Frontend("172.20.0.10", 80)),
+                lb_backends=(Backend("10.0.0.1", 8080),)))
+
+    def test_restore_accepts_legacy_conflict(self):
+        """Checkpoint restore (validate=False) must accept conflicting
+        frontends that an older engine accepted; the conflict surfaces at
+        the next regenerate instead of aborting restore half-way."""
+        from cilium_tpu.model.services import ServiceRegistry
+        reg = ServiceRegistry()
+        reg.upsert(Service(name="a", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),),
+            lb_backends=(Backend("10.0.0.1", 8080),)), validate=False)
+        reg.upsert(Service(name="b", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),),
+            lb_backends=(Backend("10.0.0.2", 8080),)), validate=False)
+        assert len(reg.match.__self__._services) == 2
+
+    def test_delete_frees_frontend(self):
+        from cilium_tpu.model.services import ServiceRegistry
+        reg = ServiceRegistry()
+        reg.upsert(Service(name="a", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),),
+            lb_backends=(Backend("10.0.0.1", 8080),)))
+        assert reg.delete("ns", "a")
+        # frontend is free again after delete
+        reg.upsert(Service(name="b", namespace="ns", frontends=(
+            Frontend("172.20.0.10", 80),),
+            lb_backends=(Backend("10.0.0.2", 8080),)))

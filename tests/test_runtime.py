@@ -337,3 +337,51 @@ class TestControllers:
         time.sleep(0.15)
         assert len(fired) == 1
         assert trig.folds == 9
+
+
+class TestFlowLogSinkCap:
+    def test_sink_buf_bounded_drop_oldest(self, tmp_path, monkeypatch):
+        """Without a flush controller the pending sink buffer must stay
+        bounded (drop-oldest, counted) instead of growing without limit."""
+        from cilium_tpu.runtime import flowlog as fl
+        monkeypatch.setattr(fl, "SINK_BUF_MAX", 10)
+        log = fl.FlowLog(capacity=4, mode="all",
+                         sink_path=str(tmp_path / "flows.jsonl"))
+        batch = {
+            "src": np.zeros((3, 4), np.uint32), "dst": np.zeros((3, 4), np.uint32),
+            "sport": np.zeros(3, np.uint32), "dport": np.zeros(3, np.uint32),
+            "proto": np.full(3, 6, np.uint32), "direction": np.zeros(3, np.uint32),
+            "ep_slot": np.zeros(3, np.uint32), "valid": np.ones(3, bool),
+        }
+        out = {
+            "allow": np.ones(3, bool), "reason": np.zeros(3, np.uint32),
+            "status": np.zeros(3, np.uint32),
+            "remote_identity": np.zeros(3, np.uint32),
+        }
+        for t in range(8):
+            log.append_batch(batch, out, now=t, ep_ids=(1,))
+        assert len(log._sink_buf) <= 10
+        assert log.sink_dropped == 8 * 3 - 10
+        # flush drains what's left; ring tail unaffected
+        assert log.flush_sink() == 10
+        assert log._sink_buf == []
+
+
+class TestRegenFailureVisibility:
+    def test_regen_failure_logged_and_counted(self, caplog):
+        """A failing auto-regen must not be silent: it logs and bumps
+        regen_failures_total so operators see stale device state."""
+        import logging as _logging
+        eng = small_engine(auto_regen=True)
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+
+        def boom(*a, **k):
+            raise RuntimeError("compile exploded")
+
+        eng.regenerate = boom
+        with caplog.at_level(_logging.ERROR, logger="cilium_tpu.engine"):
+            eng._mark_dirty_and_regen()
+        assert eng.metrics.counters.get("regen_failures_total") == 1
+        assert any("regeneration failed" in r.message for r in caplog.records)
+        assert "regen_failures_total 1" in eng.metrics.render_prometheus()
