@@ -41,6 +41,11 @@ class PolicySnapshot:
     proto_family_table: np.ndarray           # [256] int32
     world_index: int
     ct_config: CTConfig
+    # The ipcache state this snapshot was compiled from (prefix → identity).
+    # Carried so any DatapathBackend (notably the oracle-backed fake) can
+    # reconstruct the exact semantics context without reaching back into the
+    # live control plane.
+    ipcache: Dict[str, int] = field(default_factory=dict)
 
     # -- device-facing view --------------------------------------------------
     def tensors(self) -> Dict[str, np.ndarray]:
@@ -117,7 +122,8 @@ def build_snapshot(repo: Repository, ctx: PolicyContext,
     image = build_policy_image(list(policies), id_classes, port_classes, l7)
     l7_tensors = build_l7_tensors(l7)
 
-    lpm = build_lpm(ctx.ipcache.snapshot(), id_classes.index_of,
+    ipcache_snapshot = ctx.ipcache.snapshot()
+    lpm = build_lpm(ipcache_snapshot, id_classes.index_of,
                     default_index=id_classes.index_of[C.IDENTITY_WORLD])
 
     lb = build_lb(ctx.services, lb_config)  # registry → stable rev-NAT ids
@@ -136,4 +142,5 @@ def build_snapshot(repo: Repository, ctx: PolicyContext,
         proto_family_table=_proto_family_table(),
         world_index=id_classes.index_of[C.IDENTITY_WORLD],
         ct_config=ct_config or CTConfig(),
+        ipcache=ipcache_snapshot,
     )

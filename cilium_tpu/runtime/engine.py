@@ -24,11 +24,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+from cilium_tpu.compile.ct_layout import CTConfig
 from cilium_tpu.compile.lb import LBConfig
 from cilium_tpu.compile.snapshot import PolicySnapshot, build_snapshot
-from cilium_tpu.kernels.classify import make_classify_fn
-from cilium_tpu.kernels import conntrack as ctk
 from cilium_tpu.model.endpoint import Endpoint
 from cilium_tpu.model.identity import IdentityAllocator
 from cilium_tpu.model.ipcache import IPCache
@@ -39,6 +37,7 @@ from cilium_tpu.policy.repository import PolicyContext, Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.runtime.controller import ControllerManager, Trigger
+from cilium_tpu.runtime.datapath import DatapathBackend
 from cilium_tpu.runtime.flowlog import FlowLog
 from cilium_tpu.runtime.metrics import Metrics
 from cilium_tpu.utils import constants as C
@@ -46,19 +45,25 @@ from cilium_tpu.utils import constants as C
 
 @dataclass
 class CompiledSnapshot:
-    """A snapshot placed on device: what a batch classifies against."""
+    """A snapshot placed on the datapath: what a batch classifies against."""
     snapshot: PolicySnapshot
-    tensors: Dict            # device arrays
+    tensors: Dict            # the backend's placed handle (device arrays)
     world_index: int
     revision: int
 
 
 class Engine:
-    def __init__(self, config: Optional[DaemonConfig] = None):
+    """Depends only on the DatapathBackend boundary for everything device-
+    or semantics-executing (SURVEY.md §1 layer 3: the Datapath/Loader plugin
+    boundary). Constructed with a FakeDatapath it never imports jax."""
+
+    def __init__(self, config: Optional[DaemonConfig] = None,
+                 datapath: Optional[DatapathBackend] = None):
         self.config = config or DaemonConfig()
-        self._select_backend()
-        import jax.numpy as jnp
-        self._jnp = jnp
+        if datapath is None:
+            from cilium_tpu.runtime.datapath import JITDatapath
+            datapath = JITDatapath(self.config)
+        self.datapath = datapath
 
         alloc = IdentityAllocator()
         self.ctx = PolicyContext(
@@ -82,12 +87,6 @@ class Engine:
         self._lock = threading.RLock()
         self._active: Optional[CompiledSnapshot] = None
         self._dirty = True
-        self._ct = {k: jnp.asarray(v) for k, v in make_ct_arrays(
-            CTConfig(self.config.ct_capacity, self.config.probe_depth)).items()}
-        self._classify = make_classify_fn(
-            probe_depth=self.config.probe_depth,
-            v4_only=self.config.v4_only,
-            donate_ct=self.config.donate_ct)
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -101,12 +100,6 @@ class Engine:
         # LB-only service changes (no toServices rule referencing them) still
         # need a recompile: the frontend/Maglev tensors live in the snapshot
         self.ctx.services.add_observer(self._mark_dirty)
-
-    # -- backend selection ----------------------------------------------------
-    def _select_backend(self) -> None:
-        import os
-        if self.config.device == "cpu":
-            os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     # -- endpoint lifecycle (thin pkg/endpoint analog) ------------------------
     def add_endpoint(self, labels: Sequence[str], ips: Sequence[str] = (),
@@ -192,7 +185,6 @@ class Engine:
 
     def regenerate(self, force: bool = False) -> CompiledSnapshot:
         """Compile current control-plane state and swap it in atomically."""
-        jnp = self._jnp
         with self._lock:
             if not (self._dirty or force) and self._active is not None:
                 return self._active
@@ -203,7 +195,7 @@ class Engine:
                     CTConfig(self.config.ct_capacity, self.config.probe_depth),
                     LBConfig(maglev_m=self.config.maglev_m))
             with self.metrics.span("device_place").timer():
-                tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+                tensors = self.datapath.place(snap)
             compiled = CompiledSnapshot(
                 snapshot=snap, tensors=tensors,
                 world_index=snap.world_index, revision=snap.revision)
@@ -226,20 +218,14 @@ class Engine:
                  now: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Classify one batch (dict-of-arrays, kernels/records layout).
         Returns the out pytree as numpy; CT and counters update internally."""
-        jnp = self._jnp
         active = self.active
         if now is None:
             now = int(time.time())
-        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
         with self.metrics.span("classify").timer():
-            out, new_ct, counters = self._classify(
-                active.tensors, self._ct, dev_batch, jnp.uint32(now),
-                jnp.int32(active.world_index))
-            self._ct = new_ct
-            out = {k: np.asarray(v) for k, v in out.items()}
-        self.metrics.add_batch(
-            {k: np.asarray(v) for k, v in counters.items()},
-            int(np.asarray(batch["valid"]).sum()))
+            out, counters = self.datapath.classify(
+                active.tensors, active.snapshot, batch, now)
+        self.metrics.add_batch(counters,
+                               int(np.asarray(batch["valid"]).sum()))
         self.flowlog.append_batch(batch, out, now,
                                   active.snapshot.ep_ids)
         return out
@@ -248,9 +234,7 @@ class Engine:
         """CT garbage collection (upstream ctmap GC)."""
         if now is None:
             now = int(time.time())
-        new_ct, n = ctk.ct_sweep(self._ct, self._jnp.uint32(now))
-        self._ct = new_ct
-        reclaimed = int(n)
+        reclaimed = self.datapath.sweep(now)
         self.metrics.set_gauge("ct_last_sweep_reclaimed", reclaimed)
         return reclaimed
 
@@ -335,25 +319,11 @@ class Engine:
     def ct_stats(self, now: Optional[int] = None) -> Dict[str, int]:
         if now is None:
             now = int(time.time())
-        expiry = np.asarray(self._ct["expiry"])
-        return {
-            "capacity": int(expiry.shape[0]),
-            "live": int((expiry > now).sum()),
-            "stale": int(((expiry > 0) & (expiry <= now)).sum()),
-        }
+        return self.datapath.ct_stats(now)
 
     def ct_arrays(self) -> Dict[str, np.ndarray]:
         """Host copy of the CT table (checkpoint/inspection)."""
-        return {k: np.asarray(v) for k, v in self._ct.items()}
+        return self.datapath.ct_arrays()
 
     def load_ct_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
-        jnp = self._jnp
-        expected = set(self._ct.keys())
-        if "rev_nat" not in arrays and "expiry" in arrays:
-            # checkpoints written before the service rev-NAT column
-            arrays = dict(arrays)
-            arrays["rev_nat"] = np.zeros_like(arrays["expiry"])
-        if set(arrays.keys()) != expected:
-            raise ValueError(f"CT arrays mismatch: {sorted(arrays)} != "
-                             f"{sorted(expected)}")
-        self._ct = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self.datapath.load_ct_arrays(arrays)
