@@ -38,6 +38,12 @@ class L7SetInterner:
             self._index[rules] = idx
         return idx
 
+    def known(self, rules: FrozenSet[HTTPRule]):
+        """Set id if already interned, else None (non-mutating — the
+        incremental updater's geometry gate: a new set would grow the L7
+        tensors, which is a full-rebuild event)."""
+        return self._index.get(rules)
+
 
 @dataclass(frozen=True)
 class L7Tensors:

@@ -46,6 +46,14 @@ class PolicySnapshot:
     # reconstruct the exact semantics context without reaching back into the
     # live control plane.
     ipcache: Dict[str, int] = field(default_factory=dict)
+    # Compile-time context for the incremental updater's geometry gates
+    # (SURVEY.md §7 step 3 "diffable"): the L7 interner that numbered the
+    # verdict cells' set ids, and the revisions/modes the snapshot saw.
+    l7_interner: Optional[L7SetInterner] = None
+    ipcache_revision: int = -1
+    services_revision: int = -1
+    enforcement_mode: str = C.ENFORCEMENT_DEFAULT
+    allow_localhost: bool = True
 
     # -- device-facing view --------------------------------------------------
     def tensors(self) -> Dict[str, np.ndarray]:
@@ -143,4 +151,9 @@ def build_snapshot(repo: Repository, ctx: PolicyContext,
         world_index=id_classes.index_of[C.IDENTITY_WORLD],
         ct_config=ct_config or CTConfig(),
         ipcache=ipcache_snapshot,
+        l7_interner=l7,
+        ipcache_revision=ctx.ipcache.revision,
+        services_revision=ctx.services.revision,
+        enforcement_mode=ctx.enforcement_mode,
+        allow_localhost=ctx.allow_localhost,
     )

@@ -35,7 +35,12 @@ class IPCache:
     # -- mutation ------------------------------------------------------------
     def upsert(self, prefix: str, identity_id: int) -> None:
         with self._lock:
-            self._entries[normalize_prefix(prefix)] = identity_id
+            key = normalize_prefix(prefix)
+            if self._entries.get(key) == identity_id:
+                return          # no-op upserts (e.g. a DNS TTL tick
+                                # re-learning the same IPs) must not dirty
+                                # the LPM or trigger regeneration
+            self._entries[key] = identity_id
             self._changed()
 
     def delete(self, prefix: str) -> bool:

@@ -93,6 +93,11 @@ class ServiceRegistry:
         # uniqueness index consulted at upsert time (O(frontends) per upsert,
         # not a scan of every registered service).
         self._fe_owner: Dict[Tuple[bytes, int, int], Tuple[str, str]] = {}
+        self._revision = 0        # bumped on any LB-visible state change
+
+    @property
+    def revision(self) -> int:
+        return self._revision
 
     def add_observer(self, obs: Callable[[], None]) -> None:
         self._observers.append(obs)
@@ -165,6 +170,7 @@ class ServiceRegistry:
             for fe in svc.frontends:
                 self.rnat_id(fe)      # allocate eagerly, deterministically
             self._services[me] = svc
+            self._revision += 1
         for obs in list(self._observers):
             obs()
 
@@ -178,6 +184,7 @@ class ServiceRegistry:
                     k = (parse_addr(fe.addr)[0], fe.port, fe.proto)
                     if self._fe_owner.get(k) == (namespace, name):
                         del self._fe_owner[k]
+                self._revision += 1
         if ok:
             for obs in list(self._observers):
                 obs()

@@ -108,6 +108,14 @@ class MapState:
         old = self._entries.get(key)
         self._entries[key] = _merge(old, entry) if old is not None else entry
 
+    def set_entry(self, key: MapStateKey, entry: MapStateEntry) -> None:
+        """Replace (no merge) — the incremental updater writes pre-merged
+        entries (merge_contributions) directly."""
+        self._entries[key] = entry
+
+    def delete_entry(self, key: MapStateKey) -> bool:
+        return self._entries.pop(key, None) is not None
+
     # -- query --------------------------------------------------------------
     def lookup(self, remote_id: int, proto: int, dport: int) -> LookupResult:
         """The precedence ladder (see module docstring). Deterministic."""
@@ -159,6 +167,18 @@ def _rank(key: MapStateKey) -> Tuple[int, int, int, int, int]:
     """
     width = key.port_hi - key.port_lo
     return (key.specificity(), -width, key.port_lo, key.identity, key.proto)
+
+
+def merge_contributions(entries: Iterable[MapStateEntry]
+                        ) -> Optional[MapStateEntry]:
+    """Fold independent contributions to one key with the same precedence
+    `_merge` applies pairwise (deny wins; plain allow shadows L7; else L7
+    union) — the semantic result is order-independent. Returns None for an
+    empty fold (key should be deleted)."""
+    out: Optional[MapStateEntry] = None
+    for e in entries:
+        out = e if out is None else _merge(out, e)
+    return out
 
 
 def rank_scalar(key: MapStateKey) -> int:

@@ -16,8 +16,9 @@ endpoints regenerate.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from cilium_tpu.model.endpoint import Endpoint
 from cilium_tpu.model.fqdn import FQDNCache
@@ -85,6 +86,19 @@ class _RuleResources:
     has_fqdns: bool = False
 
 
+@dataclass(frozen=True)
+class RuleChange:
+    """One changelog record (consumed by the incremental tensor updater).
+    ``kind``: 'add' | 'remove' | 'refresh' (same rule, re-materialized
+    resources — the toServices/toFQDNs watcher path)."""
+    revision: int
+    kind: str
+    rule: Rule
+
+
+CHANGELOG_MAX = 4096         # history window; overflow → full-rebuild fallback
+
+
 class Repository:
     """Rule store with revisioning, resource ownership, and notification."""
 
@@ -95,6 +109,8 @@ class Repository:
         self._resources: Dict[int, _RuleResources] = {}  # id(rule) → resources
         self._revision = 1
         self._observers: List[Callable[[int], None]] = []
+        self._changes: Deque[RuleChange] = deque()
+        self._changes_dropped = False    # a record fell off the window
         ctx.services.add_observer(self._on_services_changed)
         ctx.fqdn_cache.add_observer(self._on_fqdns_changed)
 
@@ -114,9 +130,37 @@ class Repository:
             obs(rev)
         return rev
 
+    def _record(self, kind: str, rule: Rule) -> None:
+        """Changelog append (pre-bump: records carry the revision the change
+        will land in)."""
+        self._changes.append(RuleChange(self._revision + 1, kind, rule))
+        while len(self._changes) > CHANGELOG_MAX:
+            self._changes.popleft()
+            self._changes_dropped = True
+
+    def changes_since(self, revision: int) -> Optional[List[RuleChange]]:
+        """Changelog records with revision > ``revision``, or None when the
+        window no longer reaches back that far (caller must full-rebuild).
+        Consuming does not clear; call prune_changes(revision) after a
+        successful snapshot at that revision."""
+        with self._lock:
+            if self._changes_dropped:
+                return None
+            return [c for c in self._changes if c.revision > revision]
+
+    def prune_changes(self, revision: int) -> None:
+        """Drop records at or before ``revision`` (already reflected in a
+        compiled snapshot); resets the overflow marker once drained."""
+        with self._lock:
+            while self._changes and self._changes[0].revision <= revision:
+                self._changes.popleft()
+            if not self._changes:
+                self._changes_dropped = False
+
     def add(self, rules: Sequence[Rule]) -> int:
         with self._lock:
             for rule in rules:
+                self._record("add", rule)
                 self._rules.append(rule)
                 self._resources[id(rule)] = self._materialize(rule)
             return self._bump()
@@ -134,11 +178,13 @@ class Repository:
             kept: List[Rule] = []
             for r in self._rules:
                 if want.issubset(set(r.labels.to_strings())):
+                    self._record("remove", r)
                     self._release(self._resources.pop(id(r)))
                 else:
                     kept.append(r)
             self._rules = kept
             for rule in rules:
+                self._record("add", rule)
                 self._rules.append(rule)
                 self._resources[id(rule)] = self._materialize(rule)
             return self._bump()
@@ -150,6 +196,7 @@ class Repository:
         """Remove every rule (releasing owned resources)."""
         with self._lock:
             for rule in self._rules:
+                self._record("remove", rule)
                 self._release(self._resources.pop(id(rule)))
             self._rules = []
             return self._bump()
@@ -236,35 +283,36 @@ class Repository:
             if ctx.allocator.release(ident):
                 ctx.ipcache.delete(prefix)
 
-    def _on_services_changed(self) -> None:
-        """Service registry changed: re-materialize rules with toServices
-        (the k8s service-watcher → policy-recompute path)."""
+    def _refresh_rules(self, predicate) -> None:
+        """Re-materialize resources of rules matching ``predicate``.
+        Materialize-before-release: a resource present in both the old and
+        new materialization (e.g. a DNS TTL tick re-learning the same IPs)
+        keeps its refcount above zero throughout, so its identity and
+        ipcache entry survive — a no-op refresh leaves the ipcache/identity
+        state (and therefore the LPM geometry) completely untouched."""
         with self._lock:
             changed = False
             for rule in self._rules:
                 res = self._resources.get(id(rule))
-                if res is None or not res.has_services:
+                if res is None or not predicate(res):
                     continue
+                self._record("refresh", rule)
+                new_res = self._materialize(rule)
                 self._release(res)
-                self._resources[id(rule)] = self._materialize(rule)
+                self._resources[id(rule)] = new_res
                 changed = True
             if changed:
                 self._bump()
 
+    def _on_services_changed(self) -> None:
+        """Service registry changed: re-materialize rules with toServices
+        (the k8s service-watcher → policy-recompute path)."""
+        self._refresh_rules(lambda res: res.has_services)
+
     def _on_fqdns_changed(self) -> None:
         """DNS cache changed: re-materialize rules with toFQDNs (the DNS
         proxy → NameManager → policy-recompute path in upstream pkg/fqdn)."""
-        with self._lock:
-            changed = False
-            for rule in self._rules:
-                res = self._resources.get(id(rule))
-                if res is None or not res.has_fqdns:
-                    continue
-                self._release(res)
-                self._resources[id(rule)] = self._materialize(rule)
-                changed = True
-            if changed:
-                self._bump()
+        self._refresh_rules(lambda res: res.has_fqdns)
 
     # -- resolution (pure read) ---------------------------------------------
     def resolve(self, endpoint: Endpoint) -> EndpointPolicy:
@@ -286,17 +334,9 @@ class Repository:
             ingress = MapState()
             egress = MapState()
             for rule in rules:
-                res = self._resources[id(rule)]
-                tag = (rule.description or ",".join(rule.labels.to_strings())
-                       or "<unlabeled>")
-                for block in rule.ingress:
-                    self._expand(ingress, block, res, deny=False, tag=tag)
-                for block in rule.ingress_deny:
-                    self._expand(ingress, block, res, deny=True, tag=tag)
-                for block in rule.egress:
-                    self._expand(egress, block, res, deny=False, tag=tag)
-                for block in rule.egress_deny:
-                    self._expand(egress, block, res, deny=True, tag=tag)
+                for direction, key, entry in self._rule_contributions(rule):
+                    (egress if direction == C.DIR_EGRESS else ingress).add(
+                        key, entry)
 
             # Host bypass: traffic from the local host to endpoints is always
             # allowed unless host-firewall semantics are requested (upstream:
@@ -315,8 +355,41 @@ class Repository:
                 ingress=DirectionPolicy(enforce_in, ingress),
             )
 
-    def _expand(self, ms: MapState, block: RuleBlock, res: _RuleResources,
+    def _rule_contributions(self, rule: Rule
+                            ) -> List[Tuple[int, MapStateKey, MapStateEntry]]:
+        """All (direction, key, entry) contributions of one resident rule —
+        raw, pre-merge. ``resolve`` folds them into MapStates; the
+        incremental updater diffs them per rule (SURVEY.md §7 step 3)."""
+        res = self._resources[id(rule)]
+        tag = (rule.description or ",".join(rule.labels.to_strings())
+               or "<unlabeled>")
+        out: List[Tuple[int, MapStateKey, MapStateEntry]] = []
+        for direction, blocks, deny in (
+                (C.DIR_INGRESS, rule.ingress, False),
+                (C.DIR_INGRESS, rule.ingress_deny, True),
+                (C.DIR_EGRESS, rule.egress, False),
+                (C.DIR_EGRESS, rule.egress_deny, True)):
+            for block in blocks:
+                self._expand(
+                    lambda k, e, d=direction: out.append((d, k, e)),
+                    block, res, deny=deny, tag=tag)
+        return out
+
+    def expand_rule_for(self, rule: Rule, endpoint: Endpoint
+                        ) -> List[Tuple[int, MapStateKey, MapStateEntry]]:
+        """Contributions ``rule`` makes to ``endpoint``'s policy — empty if
+        the rule does not select it. Pure read (resources must already be
+        materialized, i.e. the rule is resident)."""
+        with self._lock:
+            if id(rule) not in self._resources \
+                    or not rule.selects(endpoint.labels):
+                return []
+            return self._rule_contributions(rule)
+
+    def _expand(self, sink, block: RuleBlock, res: _RuleResources,
                 deny: bool, tag: str) -> None:
+        """Expand one rule block, emitting raw (key, entry) contributions
+        through ``sink(key, entry)``."""
         block_res = res.blocks[id(block)]
 
         # Port side → list of (proto, lo, hi, l7_rules).
@@ -341,9 +414,9 @@ class Repository:
                     key = MapStateKey(identity, C.PROTO_ANY, *PORT_WILDCARD)
                 else:
                     key = MapStateKey(identity, proto, lo, hi)
-                ms.add(key, MapStateEntry(deny=deny,
-                                          l7_rules=None if deny else l7,
-                                          derived_from=(tag,)))
+                sink(key, MapStateEntry(deny=deny,
+                                        l7_rules=None if deny else l7,
+                                        derived_from=(tag,)))
 
         if block_res.wildcard:
             emit(C.IDENTITY_ANY)
