@@ -1,17 +1,19 @@
 #!/usr/bin/env python
 """Benchmarks: the five BASELINE.md configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
+headline config (5: conntrack churn — 50k-rule policy, 1M-flow CT, 10%
+new-flow rate, single chip), plus per-batch latency percentiles
+("p50_batch_ms"/"p99_batch_ms", BASELINE metric: "+ p99 batch latency") and
+a "configs" sub-object with every config's throughput + latency so
+round-over-round visibility covers the LPM-heavy and L7 shapes too.
 ``vs_baseline`` normalizes against the driver-set north star — 10M flows/sec
 on a v5e-8 (8 chips) → 1.25M flows/sec/chip; there are no reference-published
 numbers (BASELINE.json.published == {}, see BASELINE.md provenance note).
 
-Default run = config 5 (conntrack churn, the headline): 50k-rule policy,
-1M-flow CT, 10% new-flow rate, single chip.
-
 Usage:
   python bench.py [--config 1..5] [--preset smoke|full|auto]
-                  [--batch N] [--batches K] [--all]
+                  [--batch N] [--batches K] [--only]
 """
 
 from __future__ import annotations
@@ -339,13 +341,19 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     # real pipeline). One packed width per config so a single jit serves.
     host_dicts = [gen(rng, batch) for _ in range(min(batches, 16))]
     from cilium_tpu.utils import constants as C
+    from cilium_tpu.kernels.records import pack_batch_v4
     # L7 presence must be decided across ALL pre-generated batches: deciding
     # from the first alone silently drops later batches' http_path data
     # (changing measured verdicts) whenever the first happens to be L7-free.
     # (Same detection expression pack_batch uses, without packing twice.)
     has_l7 = any(bool((hb["http_method"] != C.HTTP_METHOD_ANY).any()
                       or hb["http_path"].any()) for hb in host_dicts)
-    host_batches = [pack_batch(hb, l7=has_l7) for hb in host_dicts]
+    has_v6 = any(bool(hb["is_v6"].any()) for hb in host_dicts)
+    if not has_l7 and not has_v6:
+        # compact 16B/record wire format — the transfer-bound fast path
+        host_batches = [pack_batch_v4(hb) for hb in host_dicts]
+    else:
+        host_batches = [pack_batch(hb, l7=has_l7) for hb in host_dicts]
 
     # warmup / compile
     now = 10_000
@@ -368,6 +376,21 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
         best_dt = dt if best_dt is None else min(best_dt, dt)
     throughput = batches * batch / best_dt
 
+    # per-batch latency distribution: synchronous dispatch (transfer +
+    # classify + result fence per batch) — the per-batch time an enforcing
+    # shim would wait for a verdict bitmap, deliberately unpipelined.
+    lat_n = max(20, min(batches, 50))
+    lat_ms = np.empty(lat_n)
+    for i in range(lat_n):
+        now += 1
+        t1 = time.time()
+        cur = jax.device_put(host_batches[i % len(host_batches)])
+        out, ct, counters = fn(tensors, ct, cur, jnp.uint32(now), wi)
+        jax.block_until_ready(out["allow"])
+        lat_ms[i] = (time.time() - t1) * 1e3
+    p50_ms = float(np.percentile(lat_ms, 50))
+    p99_ms = float(np.percentile(lat_ms, 99))
+
     if verbose:
         by = np.asarray(counters["by_reason_dir"]).reshape(256, 2)
         print(f"# config={config} preset={preset} platform="
@@ -375,7 +398,7 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
               f" windows={windows}\n"
               f"# compile={compile_s:.1f}s trace={trace_s:.1f}s"
               f" best-window={best_dt:.3f}s\n"
-              f"# p50 batch latency≈{best_dt / batches * 1e3:.2f} ms"
+              f"# sync batch latency p50={p50_ms:.2f}ms p99={p99_ms:.2f}ms"
               f" last-batch reasons={ {int(r): int(by[r].sum()) for r in np.nonzero(by.sum(1))[0]} }",
               file=sys.stderr)
     return {
@@ -383,6 +406,10 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
         "value": round(throughput, 1),
         "unit": "flows/sec/chip",
         "vs_baseline": round(throughput / PER_CHIP_TARGET, 4),
+        "p50_batch_ms": round(p50_ms, 3),
+        "p99_batch_ms": round(p99_ms, 3),
+        "batch": batch,
+        "preset": preset,
     }
 
 
@@ -393,9 +420,9 @@ def main(argv=None):
                     choices=["auto", "smoke", "full"])
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--batches", type=int, default=0)
-    ap.add_argument("--all", action="store_true",
-                    help="run every config (headline JSON line is still the "
-                         "--config one; others go to stderr)")
+    ap.add_argument("--only", action="store_true",
+                    help="run just --config (default: all five, with "
+                         "--config as the headline metric)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -409,14 +436,26 @@ def main(argv=None):
     batch = args.batch or (4096 if preset == "smoke" else 65536)
     batches = args.batches or (10 if preset == "smoke" else 40)
 
-    if args.all:
+    result = run_bench(args.config, preset, batch, batches,
+                       verbose=args.verbose)
+    if not args.only:
+        configs = {METRIC_NAMES[args.config]: {
+            "value": result["value"], "vs_baseline": result["vs_baseline"],
+            "p50_batch_ms": result["p50_batch_ms"],
+            "p99_batch_ms": result["p99_batch_ms"]}}
         for cfg in sorted(BUILDERS):
             if cfg == args.config:
                 continue
-            res = run_bench(cfg, preset, batch, batches, verbose=args.verbose)
+            # non-headline configs: fewer timed batches (visibility, not the
+            # headline number) so the whole sweep stays bounded
+            res = run_bench(cfg, preset, batch, max(10, batches // 2),
+                            verbose=args.verbose)
             print(json.dumps(res), file=sys.stderr)
-    result = run_bench(args.config, preset, batch, batches,
-                       verbose=args.verbose)
+            configs[METRIC_NAMES[cfg]] = {
+                "value": res["value"], "vs_baseline": res["vs_baseline"],
+                "p50_batch_ms": res["p50_batch_ms"],
+                "p99_batch_ms": res["p99_batch_ms"]}
+        result["configs"] = configs
     print(json.dumps(result))
 
 

@@ -58,8 +58,14 @@ class PolicySnapshot:
     # -- device-facing view --------------------------------------------------
     def tensors(self) -> Dict[str, np.ndarray]:
         """The flat dict of arrays the runtime places on device. Everything
-        the classify kernel reads is here; scalars live in `static_config`."""
-        return {
+        the classify kernel reads is here; scalars live in `static_config`.
+
+        LB tensors are included only when a frontend exists: the classify
+        kernel gates the whole LB stage (frontend hash probe + Maglev +
+        rev-NAT gathers) on key presence, so a service-free snapshot pays
+        zero per-packet LB cost (round-2 bench regression: cfg5 carried the
+        full LB stage with zero services)."""
+        out = {
             "verdict": self.image.verdict,
             "enforced": self.image.enforced,
             "id_class_of": self.id_classes.class_of,
@@ -72,8 +78,10 @@ class PolicySnapshot:
             "l7_path": self.l7.path,
             "l7_path_len": self.l7.path_len,
             "l7_valid": self.l7.valid,
-            **self.lb.tensors(),
         }
+        if self.lb.n_frontends:
+            out.update(self.lb.tensors())
+        return out
 
     def static_config(self) -> Dict[str, int]:
         return {

@@ -189,11 +189,17 @@ def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
     ``packed=True``: the batch argument is the single contiguous uint32 wire
     array (kernels/records.pack_batch) — one host→device transfer instead of
     twelve; unpacking happens on device and fuses into the pipeline. This is
-    the transfer-bound production path; the dict path stays for tests."""
+    the transfer-bound production path; the dict path stays for tests. The
+    wire width selects the variant at trace time: 4 words = compact v4
+    (pack_batch_v4), otherwise the full/L7 layout."""
     def fn(tensors, ct, batch, now, world_index):
         if packed:
-            from cilium_tpu.kernels.records import unpack_batch_jnp
-            batch = unpack_batch_jnp(batch)
+            from cilium_tpu.kernels.records import (
+                PACK4_WORDS, unpack_batch_jnp, unpack_batch_v4_jnp)
+            if batch.shape[1] == PACK4_WORDS:
+                batch = unpack_batch_v4_jnp(batch)
+            else:
+                batch = unpack_batch_jnp(batch)
         return classify_step(tensors, ct, batch, now, world_index,
                              probe_depth=probe_depth, v4_only=v4_only)
     return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

@@ -160,16 +160,23 @@ class ServiceRegistry:
                             f"service {svc.namespace}/{svc.name} conflicts "
                             f"with existing service {owner[0]}/{owner[1]}")
             old = self._services.get(me)
+            freed = []
             if old is not None:
                 for fe in old.frontends:
                     k = (parse_addr(fe.addr)[0], fe.port, fe.proto)
-                    if self._fe_owner.get(k) == me:
+                    if self._fe_owner.get(k) == me and k not in keys:
                         del self._fe_owner[k]
+                        freed.append(k)
             for key in keys:
                 self._fe_owner.setdefault(key, me)
             for fe in svc.frontends:
                 self.rnat_id(fe)      # allocate eagerly, deterministically
             self._services[me] = svc
+            # a key this service no longer declares may have a shadowed
+            # claimant (validate=False restores): hand ownership over so a
+            # later validated upsert can't create an undetected live conflict
+            for k in freed:
+                self._reclaim_key(k)
             self._revision += 1
         for obs in list(self._observers):
             obs()
@@ -184,11 +191,26 @@ class ServiceRegistry:
                     k = (parse_addr(fe.addr)[0], fe.port, fe.proto)
                     if self._fe_owner.get(k) == (namespace, name):
                         del self._fe_owner[k]
+                        self._reclaim_key(k)
                 self._revision += 1
         if ok:
             for obs in list(self._observers):
                 obs()
         return ok
+
+    def _reclaim_key(self, key: Tuple[bytes, int, int]) -> None:
+        """After a frontend key loses its owner, re-own it to a surviving
+        service still declaring it (deterministically: first in sorted
+        (namespace, name) order). Without this, a conflicting service let in
+        via ``validate=False`` stays shadowed with no owner recorded, and a
+        third service could later claim the key with validation passing —
+        an undetected live conflict. Caller holds the lock."""
+        from cilium_tpu.utils.ip import parse_addr
+        for me in sorted(self._services):
+            for fe in self._services[me].frontends:
+                if (parse_addr(fe.addr)[0], fe.port, fe.proto) == key:
+                    self._fe_owner[key] = me
+                    return
 
     def match(self, selector: EndpointSelector) -> List[Service]:
         with self._lock:

@@ -19,7 +19,7 @@ XLA insert collectives):
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -178,17 +178,6 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
         return out, new_ct, counters
 
     verdict_spec = P(None, None, "rules", None) if rule_sharded else P()
-    tensors_spec = {
-        "verdict": verdict_spec,
-        "enforced": P(), "id_class_of": P(), "identity_ids": P(),
-        "lpm_v4": P(), "lpm_v6": P(), "port_class": P(), "proto_family": P(),
-        "l7_methods": P(), "l7_path": P(), "l7_path_len": P(), "l7_valid": P(),
-        # LB state is replicated: small, read-only, gathered per packet
-        "lb_tab_keys": P(), "lb_tab_val": P(), "lb_fe_service": P(),
-        "lb_fe_rnat_id": P(), "lb_rnat_addr": P(), "lb_rnat_port": P(),
-        "lb_rnat_valid": P(), "lb_maglev": P(),
-        "lb_be_addr": P(), "lb_be_port": P(),
-    }
     ct_spec = {k: P("flows") for k in
                ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev",
                 "rev_nat")}
@@ -202,10 +191,26 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                  "rnat_sport")}
     counters_spec = {"by_reason_dir": P(), "insert_fail": P()}
 
-    fn = shard_map(
-        local_fn, mesh=mesh,
-        in_specs=(tensors_spec, ct_spec, batch_spec, P(), P()),
-        out_specs=(out_spec, ct_spec, counters_spec),
-        check_vma=False,
-    )
-    return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())
+    # The snapshot's tensor key-set varies (LB tensors are elided when no
+    # frontend exists), and shard_map in_specs must mirror the exact pytree —
+    # so build + cache one shard_map'd jit per key-set. Everything except the
+    # verdict is replicated (LB state included: small, read-only, gathered
+    # per packet).
+    jits: Dict[frozenset, Any] = {}
+
+    def call(tensors, ct, batch, now, world_index):
+        keyset = frozenset(tensors)
+        fn = jits.get(keyset)
+        if fn is None:
+            tensors_spec = {k: (verdict_spec if k == "verdict" else P())
+                            for k in tensors}
+            fn = jax.jit(shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(tensors_spec, ct_spec, batch_spec, P(), P()),
+                out_specs=(out_spec, ct_spec, counters_spec),
+                check_vma=False,
+            ), donate_argnums=(1,) if donate_ct else ())
+            jits[keyset] = fn
+        return fn(tensors, ct, batch, now, world_index)
+
+    return call

@@ -159,7 +159,10 @@ class JITDatapath(DatapathBackend):
         return int(n)
 
     def ct_stats(self, now: int) -> Dict[str, int]:
-        expiry = np.asarray(self._ct["expiry"])
+        # _ct buffers are donated into classify/sweep: reading outside the
+        # lock can observe deleted device arrays mid-swap. Copy inside.
+        with self._ct_lock:
+            expiry = np.asarray(self._ct["expiry"])
         return {
             "capacity": int(expiry.shape[0]),
             "live": int((expiry > now).sum()),
@@ -167,7 +170,8 @@ class JITDatapath(DatapathBackend):
         }
 
     def ct_arrays(self) -> Dict[str, np.ndarray]:
-        return {k: np.asarray(v) for k, v in self._ct.items()}
+        with self._ct_lock:
+            return {k: np.asarray(v) for k, v in self._ct.items()}
 
     def load_ct_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
         jnp = self._jnp
@@ -187,10 +191,16 @@ class FakeDatapath(DatapathBackend):
     boundary. Conntrack is the oracle's exact table; the array view is
     reconstructed on demand in the ct_layout schema."""
 
+    PLACED_KEEP = 64                     # placement history cap (memory bound)
+
     def __init__(self, config: Optional[DaemonConfig] = None):
         from oracle import ConntrackTable
         self.config = config or DaemonConfig()
-        self.placed = []                 # [(snapshot, tensors_np)], in order
+        # [(snapshot, tensors_np)], in order; a long-lived engine with
+        # auto-regen would otherwise grow this without bound — keep the most
+        # recent PLACED_KEEP (tests only assert against recent placements)
+        self.placed = []
+        self.placed_total = 0            # placements ever (incl. evicted)
         self._ct_table = ConntrackTable()
         self._oracle = None
         self._oracle_snap = None         # snapshot the cached oracle is for
@@ -217,6 +227,9 @@ class FakeDatapath(DatapathBackend):
         tensors = snap.tensors()         # numpy, no device
         with self._lock:
             self.placed.append((snap, tensors))
+            self.placed_total += 1
+            if len(self.placed) > self.PLACED_KEEP:
+                del self.placed[:-self.PLACED_KEEP]
         return tensors
 
     def classify(self, placed, snap, batch, now):
