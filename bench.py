@@ -124,7 +124,15 @@ def build_config2(preset):
         i = rng.integers(0, n_ids, n)
         b["src"][:, 3] = (0xAC100000 + ((16 + (i >> 16)) - 16 << 24)
                           + ((i >> 8) & 0xFF) * 256 + (i & 0xFF)).astype(np.uint32)
-        # (v6 share omitted from the hot loop; the snapshot still carries v6)
+        # real mixed v4/v6 (BASELINE config 2): identities with a v6 /128
+        # (every 4th) send over v6 — ~25% of traffic walks the 16-level v6
+        # LPM; the kernel compiles with v4_only=False
+        v6 = (i % 4 == 0)
+        b["is_v6"][v6] = True
+        b["src"][v6, 0] = 0x20010DB8
+        b["src"][v6, 1] = (((i[v6] >> 8) << 16) | (i[v6] & 0xFF)).astype(np.uint32)
+        b["src"][v6, 2] = 0
+        b["src"][v6, 3] = 1
         b["dst"][:, 3] = 0xC0A8000A
         b["sport"][:] = rng.integers(20000, 60000, n)
         # ~70% aimed at a port the identity's group actually allows
@@ -135,7 +143,7 @@ def build_config2(preset):
                                  rng.integers(1000, 5000, n))
         b["proto"][:] = np.where(rng.random(n) < 0.9, 6, 17)
         return b
-    return snap, gen, True
+    return snap, gen, False
 
 
 def build_config3(preset):
@@ -226,8 +234,9 @@ def build_config4(preset):
     return snap, gen, True
 
 
-def build_config5(preset):
-    """Conntrack churn: 50k-rule policy, 1M concurrent flows, 10% new rate."""
+def _config5_world(preset):
+    """The cfg5 control plane (50k-rule policy over 2k pod identities) —
+    shared by the throughput bench and the update-latency bench."""
     from cilium_tpu.model.labels import Labels
     from cilium_tpu.model.rules import parse_rule
     ctx, repo = _ctx_repo()
@@ -249,6 +258,12 @@ def build_config5(preset):
             }],
         }))
     repo.add(rules)
+    return ctx, repo, ep, n_ids, n_rules
+
+
+def build_config5(preset):
+    """Conntrack churn: 50k-rule policy, 1M concurrent flows, 10% new rate."""
+    ctx, repo, ep, n_ids, n_rules = _config5_world(preset)
     cap = 1 << (16 if preset == "smoke" else 21)
     snap = _compile(ctx, repo, [ep], cap)
 
@@ -294,6 +309,54 @@ def _base_batch(n, direction=0):
     return b
 
 
+def update_latency_bench(preset):
+    """1-rule policy-update latency on the cfg5 world: full rebuild vs the
+    incremental patch path (round-4 verdict item 2's 'done' metric; upstream
+    analog: incremental policymap diffs vs endpoint regeneration)."""
+    from cilium_tpu.compile.ct_layout import CTConfig
+    from cilium_tpu.compile.incremental import IncrementalCompiler
+    from cilium_tpu.compile.snapshot import build_snapshot
+    from cilium_tpu.model.labels import Labels
+    from cilium_tpu.model.rules import parse_rule
+
+    ctx, repo, ep, n_ids, _n_rules = _config5_world(preset)
+    ct_cfg = CTConfig(capacity=1 << 14)
+
+    t0 = time.time()
+    snap = build_snapshot(repo, ctx, [ep], ct_cfg)
+    full_s = time.time() - t0
+    t0 = time.time()
+    inc = IncrementalCompiler(repo, ctx, [ep], snap)
+    seed_s = time.time() - t0
+
+    one = parse_rule({
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"pod": "p7"}}],
+            "toPorts": [{"ports": [{"port": "4242", "protocol": "TCP"}]}]}]})
+    object.__setattr__(one, "labels", Labels.parse(["k8s:bench=u1"]))
+
+    t0 = time.time()
+    repo.add([one])
+    res = inc.try_update(ct_cfg)
+    assert res is not None, f"update fell back: {inc.last_fallback}"
+    add_s = time.time() - t0
+
+    t0 = time.time()
+    repo.delete_by_labels(Labels.parse(["k8s:bench=u1"]))
+    res = inc.try_update(ct_cfg)
+    assert res is not None, f"remove fell back: {inc.last_fallback}"
+    remove_s = time.time() - t0
+
+    return {
+        "full_rebuild_ms": round(full_s * 1e3, 1),
+        "incremental_seed_ms": round(seed_s * 1e3, 1),
+        "rule_add_ms": round(add_s * 1e3, 2),
+        "rule_remove_ms": round(remove_s * 1e3, 2),
+        "speedup_vs_full": round(full_s / max(add_s, 1e-9), 1),
+    }
+
+
 BUILDERS = {1: build_config1, 2: build_config2, 3: build_config3,
             4: build_config4, 5: build_config5}
 METRIC_NAMES = {
@@ -309,16 +372,30 @@ METRIC_NAMES = {
 # runner
 # --------------------------------------------------------------------------- #
 def run_bench(config: int, preset: str, batch: int, batches: int,
-              verbose: bool = False, windows: int = 3):
+              verbose: bool = False, windows: int = 5,
+              shards: int = 1, rule_shards: int = 1):
     """One config → throughput dict.
 
     Pipeline modeled: packed wire batches (kernels/records.pack_batch — the
     single-buffer format the C++ shim emits) are device_put with one-batch
     prefetch (the next transfer overlaps the current classify), then the
     fused classify step runs with donated CT buffers. Transfers ARE included
-    in the timing. ``windows`` timing windows are run and the best is
-    reported — the steady-state rate, robust to transport-link jitter (this
-    rig's host↔TPU tunnel varies several-fold run to run).
+    in the headline timing.
+
+    Statistics (round-4 verdict item 3: the harness must detect its own
+    noise): ``windows`` (>=5) timing windows run per mode and the MEDIAN is
+    reported with the IQR alongside — never best-of. Two modes are measured:
+    - transfer-included (the headline, what an AF_XDP pipeline sees), and
+    - compute-only (batches pre-resident on device) — separating host↔TPU
+      tunnel jitter from kernel regressions: if transfer medians move but
+      compute medians don't, the link moved, not the code.
+
+    ``shards``/``rule_shards`` > 1 route the run through the production mesh
+    path (parallel/mesh.make_sharded_classify_fn over a ('flows','rules')
+    mesh): batches host-steered by flow hash, CT sharded per chip, verdict
+    rows sharded + psum. Requires shards*rule_shards visible devices
+    (JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N
+    for a virtual mesh on a 1-chip rig).
     """
     import jax
     import jax.numpy as jnp
@@ -330,39 +407,81 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     snap, gen, v4_only = BUILDERS[config](preset)
     compile_s = time.time() - t0
 
-    tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
-    ct = {k: jnp.asarray(v) for k, v in make_ct_arrays(snap.ct_config).items()}
-    fn = make_classify_fn(v4_only=v4_only, donate_ct=True, packed=True)
     rng = np.random.default_rng(7)
     wi = jnp.int32(snap.world_index)
+    sharded = shards * rule_shards > 1
 
-    # pre-generate packed host batches (generation excluded from the timed
-    # loop — the shim does it in C++; transfer included, it is part of the
-    # real pipeline). One packed width per config so a single jit serves.
+    # pre-generate host batches (generation excluded from the timed loop —
+    # the shim does it in C++; transfer included, it is part of the real
+    # pipeline). One packed width per config so a single jit serves.
     host_dicts = [gen(rng, batch) for _ in range(min(batches, 16))]
     from cilium_tpu.utils import constants as C
     from cilium_tpu.kernels.records import pack_batch_v4
-    # L7 presence must be decided across ALL pre-generated batches: deciding
-    # from the first alone silently drops later batches' http_path data
-    # (changing measured verdicts) whenever the first happens to be L7-free.
-    # (Same detection expression pack_batch uses, without packing twice.)
-    has_l7 = any(bool((hb["http_method"] != C.HTTP_METHOD_ANY).any()
-                      or hb["http_path"].any()) for hb in host_dicts)
-    has_v6 = any(bool(hb["is_v6"].any()) for hb in host_dicts)
-    if not has_l7 and not has_v6:
-        # compact 16B/record wire format — the transfer-bound fast path
-        host_batches = [pack_batch_v4(hb) for hb in host_dicts]
+
+    if sharded:
+        from cilium_tpu.parallel.mesh import (
+            flow_shard_of, make_mesh, make_sharded_classify_fn,
+            pad_snapshot_tensors, shard_ct_arrays, steer_batch)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = make_mesh(shards, rule_shards)
+        tensors_np = pad_snapshot_tensors(snap.tensors(), rule_shards)
+        vspec = NamedSharding(mesh, P(None, None, "rules", None))
+        repl = NamedSharding(mesh, P())
+        tensors = {k: jax.device_put(v, vspec if k == "verdict" else repl)
+                   for k, v in tensors_np.items()}
+        ct_host = shard_ct_arrays(
+            make_ct_arrays(snap.ct_config), shards)
+        ct_sharding = NamedSharding(mesh, P("flows"))
+        ct = {k: jax.device_put(v, ct_sharding) for k, v in ct_host.items()}
+        fn = make_sharded_classify_fn(mesh, v4_only=v4_only, donate_ct=True)
+        # pre-steer (the C++ shim's flow_shard does this in production);
+        # one uniform per-shard size across batches → single trace
+        lb = snap.lb if snap.lb.n_frontends else None
+        per = max(int(np.bincount(
+            flow_shard_of(hb, shards, lb=lb), minlength=shards).max())
+            for hb in host_dicts)
+        per = 1 << (per - 1).bit_length()
+        host_batches = [steer_batch(hb, shards, per_shard=per, lb=lb)[0]
+                        for hb in host_dicts]
     else:
-        host_batches = [pack_batch(hb, l7=has_l7) for hb in host_dicts]
+        tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+        ct = {k: jnp.asarray(v)
+              for k, v in make_ct_arrays(snap.ct_config).items()}
+        fn = make_classify_fn(v4_only=v4_only, donate_ct=True, packed=True)
+        # L7 presence must be decided across ALL pre-generated batches:
+        # deciding from the first alone silently drops later batches'
+        # http_path data (changing measured verdicts) whenever the first
+        # happens to be L7-free.
+        has_l7 = any(bool((hb["http_method"] != C.HTTP_METHOD_ANY).any()
+                          or hb["http_path"].any()) for hb in host_dicts)
+        has_v6 = any(bool(hb["is_v6"].any()) for hb in host_dicts)
+        if not has_l7 and not has_v6:
+            # compact 16B/record wire format — the transfer-bound fast path
+            host_batches = [pack_batch_v4(hb) for hb in host_dicts]
+        elif has_l7:
+            # L7 dictionary wire: unique paths shipped once, 16-bit index
+            # per record (~20B/record instead of 76-108B; the L7 path is
+            # transfer-bound — compute-only runs >100M flows/s)
+            from cilium_tpu.kernels.records import (
+                _path_words_for, pack_batch_l7dict)
+            pw = max(_path_words_for(hb) for hb in host_dicts)
+            host_batches = [pack_batch_l7dict(hb, path_words=pw)
+                            for hb in host_dicts]
+        else:
+            host_batches = [pack_batch(hb) for hb in host_dicts]
 
     # warmup / compile
     now = 10_000
-    out, ct, counters = fn(tensors, ct, jnp.asarray(host_batches[0]),
+    out, ct, counters = fn(tensors, ct,
+                           jax.device_put(host_batches[0]),
                            jnp.uint32(now), wi)
     jax.block_until_ready(out)
     trace_s = time.time() - t0 - compile_s
 
-    best_dt = None
+    eff_batch = batch          # valid records per batch (steered pads aren't)
+
+    # -- mode 1: transfer-included (headline) ------------------------------- #
+    xfer_tp = []
     for _w in range(windows):
         nxt = jax.device_put(host_batches[0])
         t1 = time.time()
@@ -372,9 +491,36 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
             now += 1
             out, ct, counters = fn(tensors, ct, cur, jnp.uint32(now), wi)
         jax.block_until_ready(out)
-        dt = time.time() - t1
-        best_dt = dt if best_dt is None else min(best_dt, dt)
-    throughput = batches * batch / best_dt
+        xfer_tp.append(batches * eff_batch / (time.time() - t1))
+
+    # -- mode 2: compute-only (device-resident batches) --------------------- #
+    if sharded:
+        # pre-shard onto the mesh: a plain device_put would commit to one
+        # device and every call would re-distribute (still transfer-bound)
+        batch_sharding = NamedSharding(mesh, P("flows"))
+        dev_batches = [jax.device_put(hb, batch_sharding)
+                       for hb in host_batches[:4]]
+    else:
+        dev_batches = [jax.device_put(hb) for hb in host_batches[:4]]
+    jax.block_until_ready(dev_batches)
+    comp_tp = []
+    for _w in range(windows):
+        t1 = time.time()
+        for i in range(batches):
+            now += 1
+            out, ct, counters = fn(tensors, ct,
+                                   dev_batches[i % len(dev_batches)],
+                                   jnp.uint32(now), wi)
+        jax.block_until_ready(out)
+        comp_tp.append(batches * eff_batch / (time.time() - t1))
+
+    def _stats(vals):
+        v = np.asarray(vals, dtype=np.float64)
+        q1, med, q3 = np.percentile(v, [25, 50, 75])
+        return float(med), float(q3 - q1)
+
+    xfer_med, xfer_iqr = _stats(xfer_tp)
+    comp_med, comp_iqr = _stats(comp_tp)
 
     # per-batch latency distribution: synchronous dispatch (transfer +
     # classify + result fence per batch) — the per-batch time an enforcing
@@ -395,21 +541,32 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
         by = np.asarray(counters["by_reason_dir"]).reshape(256, 2)
         print(f"# config={config} preset={preset} platform="
               f"{jax.devices()[0].platform} batch={batch} batches={batches}"
-              f" windows={windows}\n"
-              f"# compile={compile_s:.1f}s trace={trace_s:.1f}s"
-              f" best-window={best_dt:.3f}s\n"
+              f" windows={windows} shards={shards}x{rule_shards}\n"
+              f"# compile={compile_s:.1f}s trace={trace_s:.1f}s\n"
+              f"# transfer-incl windows (Mfl/s): "
+              f"{[round(x / 1e6, 1) for x in xfer_tp]}\n"
+              f"# compute-only windows (Mfl/s): "
+              f"{[round(x / 1e6, 1) for x in comp_tp]}\n"
               f"# sync batch latency p50={p50_ms:.2f}ms p99={p99_ms:.2f}ms"
               f" last-batch reasons={ {int(r): int(by[r].sum()) for r in np.nonzero(by.sum(1))[0]} }",
               file=sys.stderr)
+    n_chips = shards * rule_shards
     return {
         "metric": f"flow_classify_throughput_{METRIC_NAMES[config]}",
-        "value": round(throughput, 1),
+        # sharded runs measure the whole mesh: report honestly per chip
+        "value": round(xfer_med / n_chips, 1),
         "unit": "flows/sec/chip",
-        "vs_baseline": round(throughput / PER_CHIP_TARGET, 4),
+        "vs_baseline": round(xfer_med / n_chips / PER_CHIP_TARGET, 4),
+        "iqr": round(xfer_iqr / n_chips, 1),
+        "compute_only": round(comp_med / n_chips, 1),
+        "compute_only_iqr": round(comp_iqr / n_chips, 1),
+        "windows": windows,
         "p50_batch_ms": round(p50_ms, 3),
         "p99_batch_ms": round(p99_ms, 3),
         "batch": batch,
         "preset": preset,
+        **({"shards": shards, "rule_shards": rule_shards,
+            "mesh_total": round(xfer_med, 1)} if sharded else {}),
     }
 
 
@@ -423,10 +580,30 @@ def main(argv=None):
     ap.add_argument("--only", action="store_true",
                     help="run just --config (default: all five, with "
                          "--config as the headline metric)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="flow shards (data-parallel mesh axis); >1 routes "
+                         "through the production multi-chip path")
+    ap.add_argument("--rule-shards", type=int, default=1,
+                    help="verdict-row shards (rule-space mesh axis)")
+    ap.add_argument("--windows", type=int, default=5,
+                    help="timing windows per mode (median+IQR reported)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
+    import os
+
     import jax
+    need = args.shards * args.rule_shards
+    if need > 1 and not os.environ.get("CILIUM_TPU_BENCH_REAL_MESH"):
+        # a virtual CPU mesh on a 1-chip rig (the __graft_entry__ idiom;
+        # env vars alone lose to sitecustomize TPU-plugin registration).
+        # On a real multi-chip rig set CILIUM_TPU_BENCH_REAL_MESH=1 to use
+        # the live TPU devices instead.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", need)
+        except Exception:
+            pass                       # backend already live; make_mesh checks
     platform = jax.devices()[0].platform
     preset = args.preset
     if preset == "auto":
@@ -437,7 +614,10 @@ def main(argv=None):
     batches = args.batches or (10 if preset == "smoke" else 40)
 
     result = run_bench(args.config, preset, batch, batches,
-                       verbose=args.verbose)
+                       verbose=args.verbose, windows=args.windows,
+                       shards=args.shards, rule_shards=args.rule_shards)
+    if args.shards * args.rule_shards > 1:
+        args.only = True       # the sweep is a single-chip comparison series
     if not args.only:
         configs = {METRIC_NAMES[args.config]: {
             "value": result["value"], "vs_baseline": result["vs_baseline"],
@@ -449,13 +629,14 @@ def main(argv=None):
             # non-headline configs: fewer timed batches (visibility, not the
             # headline number) so the whole sweep stays bounded
             res = run_bench(cfg, preset, batch, max(10, batches // 2),
-                            verbose=args.verbose)
+                            verbose=args.verbose, windows=args.windows)
             print(json.dumps(res), file=sys.stderr)
             configs[METRIC_NAMES[cfg]] = {
                 "value": res["value"], "vs_baseline": res["vs_baseline"],
                 "p50_batch_ms": res["p50_batch_ms"],
                 "p99_batch_ms": res["p99_batch_ms"]}
         result["configs"] = configs
+        result["update_latency"] = update_latency_bench(preset)
     print(json.dumps(result))
 
 

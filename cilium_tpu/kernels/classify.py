@@ -195,8 +195,12 @@ def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
     def fn(tensors, ct, batch, now, world_index):
         if packed:
             from cilium_tpu.kernels.records import (
-                PACK4_WORDS, unpack_batch_jnp, unpack_batch_v4_jnp)
-            if batch.shape[1] == PACK4_WORDS:
+                PACK4_WORDS, unpack_batch_jnp, unpack_batch_l7dict_jnp,
+                unpack_batch_v4_jnp)
+            if isinstance(batch, (tuple, list)):
+                # (wire, path_dict): the L7 dictionary wire format
+                batch = unpack_batch_l7dict_jnp(*batch)
+            elif batch.shape[1] == PACK4_WORDS:
                 batch = unpack_batch_v4_jnp(batch)
             else:
                 batch = unpack_batch_jnp(batch)

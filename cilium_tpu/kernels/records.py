@@ -7,7 +7,7 @@ layout doc); tests build batches from oracle PacketRecords.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -113,15 +113,41 @@ PACK_WORDS = 11
 PACK_WORDS_L7 = PACK_WORDS + C.L7_PATH_MAXLEN // 4
 
 
-def pack_batch(b: BatchArrays, l7: Optional[bool] = None) -> np.ndarray:
-    """Pack a batch dict → [N, 11] (or [N, 27] when l7) uint32.
+def _path_words_of(paths: np.ndarray) -> int:
+    """Smallest power-of-two word count covering the longest path in
+    ``paths`` [N, 64]. L7 throughput is transfer-bound and most HTTP paths
+    are short: shipping only the occupied prefix (rounded to a power of two
+    so trace shapes stay few) cuts the wire size ~2-4x vs the fixed 64-byte
+    block."""
+    nz = np.nonzero(paths.any(axis=0))[0]
+    maxlen = int(nz[-1]) + 1 if nz.size else 1
+    words = -(-maxlen // 4)
+    return min(1 << (words - 1).bit_length(), C.L7_PATH_MAXLEN // 4)
+
+
+def _path_words_for(b: BatchArrays) -> int:
+    return _path_words_of(b["http_path"])
+
+
+def pack_batch(b: BatchArrays, l7: Optional[bool] = None,
+               path_words: Optional[int] = None) -> np.ndarray:
+    """Pack a batch dict → [N, 11] (or [N, 11+path_words] when l7) uint32.
     ``l7=None`` auto-detects: include the path block iff any record carries
-    L7 tokens."""
+    L7 tokens. ``path_words`` (power of two ≤ 16) sizes the path block;
+    default = smallest power of two covering the batch's longest path."""
     if l7 is None:
         l7 = bool((b["http_method"] != C.HTTP_METHOD_ANY).any()
                   or b["http_path"].any())
+    if l7:
+        if path_words is None:
+            path_words = _path_words_for(b)
+        path_words = min(path_words, C.L7_PATH_MAXLEN // 4)
+        if b["http_path"][:, 4 * path_words:].any():
+            raise ValueError(f"path_words={path_words} truncates a path")
+    else:
+        path_words = 0
     n = b["valid"].shape[0]
-    out = np.empty((n, PACK_WORDS_L7 if l7 else PACK_WORDS), dtype=np.uint32)
+    out = np.empty((n, PACK_WORDS + path_words), dtype=np.uint32)
     out[:, 0:4] = b["src"]
     out[:, 4:8] = b["dst"]
     out[:, 8] = (b["sport"].astype(np.uint32) << 16) \
@@ -134,7 +160,8 @@ def pack_batch(b: BatchArrays, l7: Optional[bool] = None) -> np.ndarray:
         | b["valid"].astype(np.uint32)
     out[:, 10] = b["ep_slot"].astype(np.uint32)
     if l7:
-        p = b["http_path"].reshape(n, -1, 4).astype(np.uint32)
+        p = b["http_path"][:, :4 * path_words].reshape(
+            n, path_words, 4).astype(np.uint32)
         out[:, PACK_WORDS:] = ((p[:, :, 0] << 24) | (p[:, :, 1] << 16)
                                | (p[:, :, 2] << 8) | p[:, :, 3])
     return out
@@ -170,6 +197,98 @@ def pack_batch_v4(b: BatchArrays) -> np.ndarray:
         | (b["direction"].astype(np.uint32) << 1) \
         | b["valid"].astype(np.uint32)
     return out
+
+
+# --------------------------------------------------------------------------- #
+# L7 dictionary wire format: (wire, path_dict) — real HTTP traffic repeats a
+# small set of paths, so shipping the 64B path block per record wastes ~80%
+# of the link. Instead: unique paths once per batch ([U, P] packed words,
+# U padded to a power of two for trace stability) + a 16-bit dictionary
+# index per record; the device gathers the path bytes back during unpack.
+# cfg4 measurement (round 5): the L7 kernel runs >100M flows/s compute-only;
+# the fixed-block wire capped it at ~1.3M. 20B/record vs 76-108B.
+#
+# v4-compact variant ([N, 5]): PACK4 words 0-3 + word 4 = method<<24|path_idx.
+# full variant ([N, 12]): PACK words 0-10 + word 11 = path_idx (method is
+# already in word 9).
+# --------------------------------------------------------------------------- #
+PACK4_L7_WORDS = PACK4_WORDS + 1
+PACK_L7DICT_WORDS = PACK_WORDS + 1
+
+
+def _pack_path_dict(paths: np.ndarray, path_words: Optional[int]
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """[N, 64] uint8 → (dict_words [U_pow2, P] uint32, index [N] int64)."""
+    uniq, idx = np.unique(paths, axis=0, return_inverse=True)
+    if uniq.shape[0] > 65536:
+        raise ValueError("path dictionary overflow (>64k unique paths)")
+    if path_words is None:
+        path_words = _path_words_of(uniq)
+    path_words = min(path_words, C.L7_PATH_MAXLEN // 4)
+    if uniq[:, 4 * path_words:].any():
+        raise ValueError(f"path_words={path_words} truncates a path")
+    u_pad = 1 << max(0, (uniq.shape[0] - 1)).bit_length()
+    p = np.zeros((u_pad, 4 * path_words), dtype=np.uint32)
+    p[:uniq.shape[0]] = uniq[:, :4 * path_words]
+    p = p.reshape(u_pad, path_words, 4)
+    words = ((p[:, :, 0] << 24) | (p[:, :, 1] << 16)
+             | (p[:, :, 2] << 8) | p[:, :, 3])
+    return words, idx
+
+
+def pack_batch_l7dict(b: BatchArrays, path_words: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack an L7 batch as (wire, path_dict). Picks the 5-word v4-compact
+    wire when the batch qualifies, else the 12-word full wire."""
+    dict_words, idx = _pack_path_dict(b["http_path"], path_words)
+    n = b["valid"].shape[0]
+    if not b["is_v6"].any() and not (b["ep_slot"] > PACK4_EP_SLOT_MAX).any():
+        wire = np.empty((n, PACK4_L7_WORDS), dtype=np.uint32)
+        wire[:, 0] = b["src"][:, 3]
+        wire[:, 1] = b["dst"][:, 3]
+        wire[:, 2] = (b["sport"].astype(np.uint32) << 16) \
+            | b["dport"].astype(np.uint32)
+        wire[:, 3] = (b["proto"].astype(np.uint32) << 24) \
+            | (b["tcp_flags"].astype(np.uint32) << 16) \
+            | (b["ep_slot"].astype(np.uint32) << 2) \
+            | (b["direction"].astype(np.uint32) << 1) \
+            | b["valid"].astype(np.uint32)
+        wire[:, 4] = (b["http_method"].astype(np.uint32) << 24) \
+            | idx.astype(np.uint32)
+        return wire, dict_words
+    wire = np.empty((n, PACK_L7DICT_WORDS), dtype=np.uint32)
+    wire[:, :PACK_WORDS] = pack_batch(b, l7=False)
+    wire[:, PACK_WORDS] = idx.astype(np.uint32)
+    return wire, dict_words
+
+
+def _unpack_dict_paths_jnp(dict_words, idx):
+    import jax.numpy as jnp
+    words = dict_words[idx]                                # [N, P]
+    n = words.shape[0]
+    path = jnp.stack([(words >> 24) & 0xFF, (words >> 16) & 0xFF,
+                      (words >> 8) & 0xFF, words & 0xFF],
+                     axis=-1).reshape(n, -1).astype(jnp.uint8)
+    pad = C.L7_PATH_MAXLEN - path.shape[1]
+    if pad > 0:
+        path = jnp.pad(path, ((0, 0), (0, pad)))
+    return path
+
+
+def unpack_batch_l7dict_jnp(wire, dict_words):
+    """Device-side unpack of either l7-dict wire variant."""
+    import jax.numpy as jnp
+    if wire.shape[1] == PACK4_L7_WORDS:
+        b = unpack_batch_v4_jnp(wire[:, :PACK4_WORDS])
+        w4 = wire[:, 4]
+        b["http_method"] = (w4 >> 24).astype(jnp.int32)
+        b["http_path"] = _unpack_dict_paths_jnp(
+            dict_words, (w4 & 0xFFFF).astype(jnp.int32))
+        return b
+    b = unpack_batch_jnp(wire[:, :PACK_WORDS])
+    b["http_path"] = _unpack_dict_paths_jnp(
+        dict_words, (wire[:, PACK_WORDS] & 0xFFFF).astype(jnp.int32))
+    return b
 
 
 def unpack_batch_v4_jnp(packed):
@@ -220,6 +339,9 @@ def unpack_batch_jnp(packed):
         path = jnp.stack([(words >> 24) & 0xFF, (words >> 16) & 0xFF,
                           (words >> 8) & 0xFF, words & 0xFF],
                          axis=-1).reshape(n, -1).astype(jnp.uint8)
+        pad = C.L7_PATH_MAXLEN - path.shape[1]
+        if pad > 0:        # variable-width wire: restore the full 64B block
+            path = jnp.pad(path, ((0, 0), (0, pad)))
         b["http_path"] = path
     else:
         b["http_path"] = jnp.zeros((n, C.L7_PATH_MAXLEN), dtype=jnp.uint8)

@@ -38,6 +38,10 @@ class DaemonConfig:
     sweep_interval_s: float = 30.0
     regen_debounce_s: float = 0.1
     auto_regen: bool = True
+    # incremental regeneration: patch the compiled snapshot through the
+    # repository changelog instead of full recompiles (geometry changes
+    # still fall back to a full build — compile/incremental.py gates)
+    incremental: bool = True
     # --- observability ---
     flowlog_capacity: int = 16384
     flowlog_mode: str = "drops"    # all | drops | none

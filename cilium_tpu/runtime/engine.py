@@ -87,6 +87,7 @@ class Engine:
         self._lock = threading.RLock()
         self._active: Optional[CompiledSnapshot] = None
         self._dirty = True
+        self._inc = None           # IncrementalCompiler, seeded on full build
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -184,18 +185,57 @@ class Engine:
                 self.metrics.inc_counter("regen_failures_total")
 
     def regenerate(self, force: bool = False) -> CompiledSnapshot:
-        """Compile current control-plane state and swap it in atomically."""
+        """Compile current control-plane state and swap it in atomically.
+
+        With ``config.incremental`` the regeneration first tries to patch
+        the active snapshot through the repository changelog
+        (compile/incremental.IncrementalCompiler — the upstream analog of
+        incremental policymap diffs, SURVEY.md §3.2); geometry gates fall
+        back to the full compiler and re-seed the patcher."""
         with self._lock:
             if not (self._dirty or force) and self._active is not None:
                 return self._active
-            with self.metrics.span("snapshot_compile").timer():
-                snap = build_snapshot(
-                    self.repo, self.ctx,
-                    sorted(self.endpoints.values(), key=lambda e: e.ep_id),
-                    CTConfig(self.config.ct_capacity, self.config.probe_depth),
-                    LBConfig(maglev_m=self.config.maglev_m))
+            eps = sorted(self.endpoints.values(), key=lambda e: e.ep_id)
+            ct_cfg = CTConfig(self.config.ct_capacity,
+                              self.config.probe_depth)
+            lb_cfg = LBConfig(maglev_m=self.config.maglev_m)
+
+            snap = patch = None
+            if (self._inc is not None and self._active is not None
+                    and not force):
+                with self.metrics.span("snapshot_patch").timer():
+                    result = self._inc.try_update(ct_cfg, lb_cfg,
+                                                  endpoints=eps)
+                if result is not None:
+                    snap, patch, stats = result
+                    self.metrics.inc_counter("regen_incremental_total")
+                    self.metrics.set_gauge("regen_last_rows_patched",
+                                           stats.rows_recomputed)
+                else:
+                    logging.getLogger("cilium_tpu.engine").debug(
+                        "incremental fallback: %s", self._inc.last_fallback)
+
+            if snap is None:
+                with self.metrics.span("snapshot_compile").timer():
+                    snap = build_snapshot(self.repo, self.ctx, eps,
+                                          ct_cfg, lb_cfg)
+                self.metrics.inc_counter("regen_full_total")
+                if self.config.incremental:
+                    from cilium_tpu.compile.incremental import \
+                        IncrementalCompiler
+                    self._inc = IncrementalCompiler(self.repo, self.ctx,
+                                                    eps, snap)
+
             with self.metrics.span("device_place").timer():
-                tensors = self.datapath.place(snap)
+                if patch is not None and self._active is not None:
+                    if patch.is_noop:
+                        tensors = self._active.tensors
+                    else:
+                        tensors = self.datapath.place_patch(
+                            self._active.tensors, snap, patch)
+                else:
+                    tensors = self.datapath.place(snap)
+            self.repo.prune_changes(snap.revision)
             compiled = CompiledSnapshot(
                 snapshot=snap, tensors=tensors,
                 world_index=snap.world_index, revision=snap.revision)

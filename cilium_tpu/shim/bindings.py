@@ -56,7 +56,8 @@ class ShimTokens(ctypes.Structure):
 class ShimStats(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "frames_seen", "frames_parsed", "parse_errors", "batches_emitted",
-        "records_emitted", "verdict_drops", "verdict_passes")]
+        "records_emitted", "verdict_drops", "verdict_passes",
+        "tx_full_drops")]
 
 
 def _load_lib():
@@ -94,6 +95,21 @@ def _load_lib():
         ctypes.c_uint32]
     lib.shim_afxdp_bind.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.shim_afxdp_poll.restype = ctypes.c_int
+    lib.shim_afxdp_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.shim_mock_rings_init.restype = ctypes.c_int
+    lib.shim_mock_rings_init.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32]
+    lib.shim_mock_rx_inject.restype = ctypes.c_int
+    lib.shim_mock_rx_inject.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.shim_mock_tx_drain.restype = ctypes.c_uint32
+    lib.shim_mock_tx_drain.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32]
+    lib.shim_ring_fill_level.restype = ctypes.c_uint32
+    lib.shim_ring_fill_level.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -194,6 +210,34 @@ class FlowShim:
 
     def afxdp_bind(self, ifname: str, queue: int = 0) -> int:
         return self._lib.shim_afxdp_bind(self._handle, ifname.encode(), queue)
+
+    # -- ring path (kernel-mapped after afxdp_bind; heap-mocked for tests) --
+    def afxdp_poll(self, budget: int = 256, now_us: int = 0) -> int:
+        """Drain the rx ring into the batcher (completion→fill recycle
+        first). Returns descriptors drained, or -errno."""
+        return self._lib.shim_afxdp_poll(self._handle, budget, now_us)
+
+    def mock_rings_init(self, ring_size: int = 64, frame_size: int = 2048,
+                        n_frames: int = 64) -> None:
+        rc = self._lib.shim_mock_rings_init(self._handle, ring_size,
+                                            frame_size, n_frames)
+        if rc != 0:
+            raise OSError(-rc, "shim_mock_rings_init failed")
+
+    def mock_rx_inject(self, frame: bytes) -> int:
+        """Act as the NIC: fill-ring frame ← frame bytes → rx descriptor."""
+        return self._lib.shim_mock_rx_inject(self._handle, frame, len(frame))
+
+    def mock_tx_drain(self, max_n: int = 256):
+        """Act as the NIC's tx side: returns [(umem_addr, len)] of frames
+        the shim forwarded; marks them transmitted via the completion ring."""
+        addrs = (ctypes.c_uint64 * max_n)()
+        lens = (ctypes.c_uint32 * max_n)()
+        n = self._lib.shim_mock_tx_drain(self._handle, addrs, lens, max_n)
+        return [(addrs[i], lens[i]) for i in range(n)]
+
+    def ring_fill_level(self) -> int:
+        return self._lib.shim_ring_fill_level(self._handle)
 
 
 # --------------------------------------------------------------------------- #

@@ -76,10 +76,77 @@ inline uint32_t be32(const uint8_t* p) {
 const char* kMethods[] = {"GET",     "POST",  "PUT",   "DELETE", "HEAD",
                           "OPTIONS", "PATCH", "TRACE", "CONNECT"};
 
+// Frame provenance of a record: where the bytes live so the verdict can be
+// enforced on them (umem frames recycle to fill on drop, forward via tx on
+// pass; mock-driver records have no frame to enforce on).
+struct FrameRef {
+  uint64_t addr = 0;
+  uint32_t len = 0;
+  bool umem = false;
+};
+
 struct PendingRecord {
   ShimRecord rec;
   ShimTokens tok;
+  FrameRef frame;
 };
+
+// One single-producer/single-consumer AF_XDP ring view. The kernel maps
+// producer/consumer indices and the descriptor array at fixed offsets; the
+// mock backs them with heap memory. Index arithmetic is free-running uint32
+// (entries = prod - cons), acquire/release on the shared indices — the same
+// contract the kernel's xsk rings use.
+struct Ring {
+  volatile uint32_t* producer = nullptr;
+  volatile uint32_t* consumer = nullptr;
+  void* desc = nullptr;
+  uint32_t size = 0;  // entries, power of two
+};
+
+static inline uint32_t ring_load_prod(const Ring& r) {
+  return __atomic_load_n(r.producer, __ATOMIC_ACQUIRE);
+}
+static inline uint32_t ring_load_cons(const Ring& r) {
+  return __atomic_load_n(r.consumer, __ATOMIC_ACQUIRE);
+}
+static inline uint32_t ring_entries(const Ring& r) {
+  return ring_load_prod(r) - ring_load_cons(r);
+}
+static inline uint32_t ring_free(const Ring& r) {
+  return r.size - ring_entries(r);
+}
+
+// fill/completion rings carry bare umem addresses (uint64)
+static bool ring_push_addr(Ring& r, uint64_t addr) {
+  if (ring_free(r) == 0) return false;
+  uint32_t prod = *r.producer;
+  static_cast<uint64_t*>(r.desc)[prod & (r.size - 1)] = addr;
+  __atomic_store_n(r.producer, prod + 1, __ATOMIC_RELEASE);
+  return true;
+}
+static bool ring_pop_addr(Ring& r, uint64_t* addr) {
+  if (ring_entries(r) == 0) return false;
+  uint32_t cons = *r.consumer;
+  *addr = static_cast<const uint64_t*>(r.desc)[cons & (r.size - 1)];
+  __atomic_store_n(r.consumer, cons + 1, __ATOMIC_RELEASE);
+  return true;
+}
+
+// rx/tx rings carry descriptors
+static bool ring_push_desc(Ring& r, const ShimXdpDesc& d) {
+  if (ring_free(r) == 0) return false;
+  uint32_t prod = *r.producer;
+  static_cast<ShimXdpDesc*>(r.desc)[prod & (r.size - 1)] = d;
+  __atomic_store_n(r.producer, prod + 1, __ATOMIC_RELEASE);
+  return true;
+}
+static bool ring_pop_desc(Ring& r, ShimXdpDesc* d) {
+  if (ring_entries(r) == 0) return false;
+  uint32_t cons = *r.consumer;
+  *d = static_cast<const ShimXdpDesc*>(r.desc)[cons & (r.size - 1)];
+  __atomic_store_n(r.consumer, cons + 1, __ATOMIC_RELEASE);
+  return true;
+}
 
 }  // namespace
 
@@ -91,6 +158,11 @@ struct Shim {
   std::vector<std::pair<std::array<uint8_t, 16>, uint32_t>> endpoints;
   ShimStats stats{};
   uint32_t next_frame_idx = 0;
+  // frames of emitted-but-unverdicted batches, in emission order —
+  // shim_apply_verdicts consumes from the front (FIFO matches the
+  // poll_batch → classify → verdict pipeline, including when several
+  // batches are in flight)
+  std::deque<FrameRef> emitted;
   // service LB steering state (see shim_set_lb)
   std::vector<uint32_t> lb_tab_keys;  // [cap*6]
   std::vector<int32_t> lb_tab_val;    // [cap]
@@ -101,10 +173,23 @@ struct Shim {
   uint32_t lb_maglev_m = 0;
   std::vector<uint32_t> lb_be_addr;    // [B*4]
   std::vector<int32_t> lb_be_port;     // [B]
-#if FLOWSHIM_HAVE_AFXDP
+  // umem + rings (kernel-mapped after afxdp_bind, heap-backed after
+  // mock_rings_init)
   int xsk_fd = -1;
-  void* umem_area = nullptr;
+  uint8_t* umem_area = nullptr;
   size_t umem_size = 0;
+  uint32_t frame_size = 0;
+  bool rings_ready = false;
+  bool rings_mock = false;
+  Ring fill, comp, rx, tx;
+  // mock-mode backing storage
+  std::vector<uint64_t> mock_addr_mem;   // fill+comp descriptor arrays
+  std::vector<ShimXdpDesc> mock_desc_mem;  // rx+tx descriptor arrays
+  std::vector<uint32_t> mock_idx_mem;    // producer/consumer indices
+  std::vector<uint8_t> mock_umem;
+#if FLOWSHIM_HAVE_AFXDP
+  void* ring_maps[4] = {nullptr, nullptr, nullptr, nullptr};
+  size_t ring_map_lens[4] = {0, 0, 0, 0};
 #endif
 };
 
@@ -120,7 +205,9 @@ Shim* shim_create(uint32_t batch_size, uint64_t timeout_us) {
 void shim_destroy(Shim* s) {
 #if FLOWSHIM_HAVE_AFXDP
   if (s->xsk_fd >= 0) close(s->xsk_fd);
-  if (s->umem_area) munmap(s->umem_area, s->umem_size);
+  for (int i = 0; i < 4; i++)
+    if (s->ring_maps[i]) munmap(s->ring_maps[i], s->ring_map_lens[i]);
+  if (s->umem_area && !s->rings_mock) munmap(s->umem_area, s->umem_size);
 #endif
   delete s;
 }
@@ -276,6 +363,7 @@ uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
   for (uint32_t i = 0; i < n; i++) {
     out_records[i] = s->pending.front().rec;
     out_tokens[i] = s->pending.front().tok;
+    s->emitted.push_back(s->pending.front().frame);
     s->pending.pop_front();
   }
   if (!s->pending.empty()) s->first_pending_ts = now_us;
@@ -284,15 +372,46 @@ uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
   return n;
 }
 
+static void kick_tx(Shim* s) {
+#if FLOWSHIM_HAVE_AFXDP
+  if (s->xsk_fd >= 0)
+    sendto(s->xsk_fd, nullptr, 0, MSG_DONTWAIT, nullptr, 0);
+#else
+  (void)s;
+#endif
+}
+
 void shim_apply_verdicts(Shim* s, const uint8_t* allow, uint32_t n) {
+  bool sent = false;
   for (uint32_t i = 0; i < n; i++) {
-    if (allow[i])
-      s->stats.verdict_passes++;
-    else
+    FrameRef fr;
+    if (!s->emitted.empty()) {
+      fr = s->emitted.front();
+      s->emitted.pop_front();
+    }
+    if (allow[i]) {
+      if (fr.umem && s->rings_ready) {
+        // forward: hand the frame to the tx ring; the frame returns to the
+        // fill ring via the completion ring once the NIC is done with it
+        ShimXdpDesc d{fr.addr, fr.len, 0};
+        if (ring_push_desc(s->tx, d)) {
+          sent = true;
+          s->stats.verdict_passes++;
+        } else {
+          // tx ring full → drop rather than leak the frame; counted apart
+          // from policy drops so NIC backpressure loss is visible
+          s->stats.tx_full_drops++;
+          ring_push_addr(s->fill, fr.addr);
+        }
+      } else {
+        s->stats.verdict_passes++;
+      }
+    } else {
       s->stats.verdict_drops++;
+      if (fr.umem && s->rings_ready) ring_push_addr(s->fill, fr.addr);
+    }
   }
-  // AF_XDP mode would recycle dropped frames into the fill ring and submit
-  // passed frames to the tx ring here.
+  if (sent) kick_tx(s);
 }
 
 void shim_get_stats(const Shim* s, ShimStats* out) { *out = s->stats; }
@@ -375,20 +494,117 @@ uint32_t shim_flow_shard2(const Shim* s, const ShimRecord* rec,
 }
 
 // ---------------------------------------------------------------------------
-// AF_XDP (privileged; graceful -errno in unprivileged containers)
+// The ring-draining packet path (shared by kernel-mapped and mocked rings):
+//   1. completion → fill: frames the NIC finished transmitting recycle;
+//   2. rx walk: each descriptor's umem frame goes through the parser into
+//      the batcher, carrying its FrameRef for verdict enforcement;
+//      unparseable frames recycle to the fill ring immediately (they never
+//      reach the classifier — the upstream analog is an XDP_DROP before the
+//      tc layer).
+// ---------------------------------------------------------------------------
+int shim_afxdp_poll(Shim* s, uint32_t budget, uint64_t now_us) {
+  if (!s->rings_ready) return s->xsk_fd < 0 ? -EBADF : -EINVAL;
+  uint64_t addr;
+  while (ring_pop_addr(s->comp, &addr)) ring_push_addr(s->fill, addr);
+
+  uint32_t drained = 0;
+  ShimXdpDesc d;
+  while (drained < budget && ring_entries(s->rx) > 0) {
+    if (!ring_pop_desc(s->rx, &d)) break;
+    drained++;
+    s->stats.frames_seen++;
+    const uint8_t* frame = s->umem_area + d.addr;
+    PendingRecord pr;
+    if (!parse_frame(s, frame, d.len, &pr)) {
+      s->stats.parse_errors++;
+      ring_push_addr(s->fill, d.addr);
+      continue;
+    }
+    pr.rec.frame_idx = s->next_frame_idx++;
+    pr.frame = FrameRef{d.addr, d.len, true};
+    if (s->pending.empty()) s->first_pending_ts = now_us;
+    s->pending.push_back(pr);
+    s->stats.frames_parsed++;
+  }
+  return int(drained);
+}
+
+// ---------------------------------------------------------------------------
+// Memory-mocked rings (unprivileged testbench for the path above)
+// ---------------------------------------------------------------------------
+int shim_mock_rings_init(Shim* s, uint32_t ring_size, uint32_t frame_size,
+                         uint32_t n_frames) {
+  if (s->rings_ready) return -EBUSY;
+  if (!ring_size || (ring_size & (ring_size - 1))) return -EINVAL;
+  if (!frame_size || !n_frames) return -EINVAL;
+  s->mock_umem.assign(size_t(frame_size) * n_frames, 0);
+  s->umem_area = s->mock_umem.data();
+  s->umem_size = s->mock_umem.size();
+  s->frame_size = frame_size;
+  s->mock_addr_mem.assign(size_t(ring_size) * 2, 0);
+  s->mock_desc_mem.assign(size_t(ring_size) * 2, ShimXdpDesc{});
+  s->mock_idx_mem.assign(8, 0);
+  s->fill = Ring{&s->mock_idx_mem[0], &s->mock_idx_mem[1],
+                 s->mock_addr_mem.data(), ring_size};
+  s->comp = Ring{&s->mock_idx_mem[2], &s->mock_idx_mem[3],
+                 s->mock_addr_mem.data() + ring_size, ring_size};
+  s->rx = Ring{&s->mock_idx_mem[4], &s->mock_idx_mem[5],
+               s->mock_desc_mem.data(), ring_size};
+  s->tx = Ring{&s->mock_idx_mem[6], &s->mock_idx_mem[7],
+               s->mock_desc_mem.data() + ring_size, ring_size};
+  for (uint32_t i = 0; i < n_frames && ring_free(s->fill); i++)
+    ring_push_addr(s->fill, uint64_t(i) * frame_size);
+  s->rings_ready = true;
+  s->rings_mock = true;
+  return 0;
+}
+
+int shim_mock_rx_inject(Shim* s, const uint8_t* frame, uint32_t len) {
+  if (!s->rings_mock) return -EINVAL;
+  if (len > s->frame_size) return -EMSGSIZE;
+  if (ring_free(s->rx) == 0) return -ENOSPC;
+  uint64_t addr;
+  if (!ring_pop_addr(s->fill, &addr)) return -ENOSPC;
+  memcpy(s->umem_area + addr, frame, len);
+  ring_push_desc(s->rx, ShimXdpDesc{addr, len, 0});
+  return 0;
+}
+
+uint32_t shim_mock_tx_drain(Shim* s, uint64_t* addrs, uint32_t* lens,
+                            uint32_t max) {
+  if (!s->rings_mock) return 0;
+  uint32_t n = 0;
+  ShimXdpDesc d;
+  while (n < max && ring_pop_desc(s->tx, &d)) {
+    if (addrs) addrs[n] = d.addr;
+    if (lens) lens[n] = d.len;
+    ring_push_addr(s->comp, d.addr);  // "transmitted" → completion
+    n++;
+  }
+  return n;
+}
+
+uint32_t shim_ring_fill_level(const Shim* s) {
+  return s->rings_ready ? ring_entries(s->fill) : 0;
+}
+
+// ---------------------------------------------------------------------------
+// AF_XDP socket setup (privileged; graceful -errno in unprivileged
+// containers — callers fall back to mock rings or the mock driver)
 // ---------------------------------------------------------------------------
 #if FLOWSHIM_HAVE_AFXDP
 static constexpr uint32_t kFrameSize = 2048;
 static constexpr uint32_t kNumFrames = 4096;
 
 int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id) {
+  if (s->rings_ready) return -EBUSY;
   unsigned ifindex = if_nametoindex(ifname);
   if (!ifindex) return -ENODEV;
   int fd = socket(AF_XDP, SOCK_RAW, 0);
   if (fd < 0) return -errno;
 
-  s->umem_size = size_t(kFrameSize) * kNumFrames;
-  void* area = mmap(nullptr, s->umem_size, PROT_READ | PROT_WRITE,
+  size_t umem_size = size_t(kFrameSize) * kNumFrames;
+  void* area = mmap(nullptr, umem_size, PROT_READ | PROT_WRITE,
                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_POPULATE, -1, 0);
   if (area == MAP_FAILED) {
     close(fd);
@@ -396,11 +612,11 @@ int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id) {
   }
   struct xdp_umem_reg umem_reg = {};
   umem_reg.addr = reinterpret_cast<uint64_t>(area);
-  umem_reg.len = s->umem_size;
+  umem_reg.len = umem_size;
   umem_reg.chunk_size = kFrameSize;
   if (setsockopt(fd, SOL_XDP, XDP_UMEM_REG, &umem_reg, sizeof(umem_reg)) < 0) {
     int err = -errno;
-    munmap(area, s->umem_size);
+    munmap(area, umem_size);
     close(fd);
     return err;
   }
@@ -410,6 +626,56 @@ int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id) {
   setsockopt(fd, SOL_XDP, XDP_RX_RING, &ring_sz, sizeof(ring_sz));
   setsockopt(fd, SOL_XDP, XDP_TX_RING, &ring_sz, sizeof(ring_sz));
 
+  // map the four rings at the kernel-reported offsets
+  struct xdp_mmap_offsets off = {};
+  socklen_t optlen = sizeof(off);
+  if (getsockopt(fd, SOL_XDP, XDP_MMAP_OFFSETS, &off, &optlen) < 0) {
+    int err = -errno;
+    munmap(area, umem_size);
+    close(fd);
+    return err;
+  }
+  struct MapSpec {
+    uint64_t pgoff;
+    uint64_t prod_off, cons_off, desc_off;
+    uint32_t entries;
+    size_t desc_bytes;
+    Ring* ring;
+  } specs[4] = {
+      {XDP_UMEM_PGOFF_FILL_RING, off.fr.producer, off.fr.consumer,
+       off.fr.desc, ring_sz, sizeof(uint64_t), &s->fill},
+      {XDP_UMEM_PGOFF_COMPLETION_RING, off.cr.producer, off.cr.consumer,
+       off.cr.desc, ring_sz, sizeof(uint64_t), &s->comp},
+      {XDP_PGOFF_RX_RING, off.rx.producer, off.rx.consumer, off.rx.desc,
+       ring_sz, sizeof(struct xdp_desc), &s->rx},
+      {XDP_PGOFF_TX_RING, off.tx.producer, off.tx.consumer, off.tx.desc,
+       ring_sz, sizeof(struct xdp_desc), &s->tx},
+  };
+  for (int i = 0; i < 4; i++) {
+    size_t len = specs[i].desc_off + size_t(specs[i].entries) *
+                                         specs[i].desc_bytes;
+    void* m = mmap(nullptr, len, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, specs[i].pgoff);
+    if (m == MAP_FAILED) {
+      int err = -errno;
+      for (int j = 0; j < i; j++) {
+        munmap(s->ring_maps[j], s->ring_map_lens[j]);
+        s->ring_maps[j] = nullptr;
+        s->ring_map_lens[j] = 0;
+      }
+      munmap(area, umem_size);
+      close(fd);
+      return err;
+    }
+    s->ring_maps[i] = m;
+    s->ring_map_lens[i] = len;
+    uint8_t* base = static_cast<uint8_t*>(m);
+    *specs[i].ring = Ring{
+        reinterpret_cast<volatile uint32_t*>(base + specs[i].prod_off),
+        reinterpret_cast<volatile uint32_t*>(base + specs[i].cons_off),
+        base + specs[i].desc_off, specs[i].entries};
+  }
+
   struct sockaddr_xdp sxdp = {};
   sxdp.sxdp_family = AF_XDP;
   sxdp.sxdp_ifindex = ifindex;
@@ -417,28 +683,27 @@ int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id) {
   sxdp.sxdp_flags = XDP_COPY;  // portable; zerocopy negotiated by drivers
   if (bind(fd, reinterpret_cast<struct sockaddr*>(&sxdp), sizeof(sxdp)) < 0) {
     int err = -errno;
-    munmap(area, s->umem_size);
+    for (int j = 0; j < 4; j++) {
+      munmap(s->ring_maps[j], s->ring_map_lens[j]);
+      s->ring_maps[j] = nullptr;
+      s->ring_map_lens[j] = 0;
+    }
+    munmap(area, umem_size);
     close(fd);
     return err;
   }
   s->xsk_fd = fd;
-  s->umem_area = area;
+  s->umem_area = static_cast<uint8_t*>(area);
+  s->umem_size = umem_size;
+  s->frame_size = kFrameSize;
+  // prime the fill ring: hand every frame to the NIC for rx
+  for (uint32_t i = 0; i < kNumFrames && ring_free(s->fill); i++)
+    ring_push_addr(s->fill, uint64_t(i) * kFrameSize);
+  s->rings_ready = true;
   return 0;
-}
-
-int shim_afxdp_poll(Shim* s, uint32_t budget, uint64_t now_us) {
-  if (s->xsk_fd < 0) return -EBADF;
-  // Ring-draining requires mmap'ing the rx ring offsets (XDP_MMAP_OFFSETS)
-  // and walking descriptors; each descriptor's frame is handed to
-  // shim_feed_frame. Left as the documented next step — this build cannot
-  // exercise it without a privileged netns + XDP driver (see shim/README).
-  (void)budget;
-  (void)now_us;
-  return -EOPNOTSUPP;
 }
 #else   // !FLOWSHIM_HAVE_AFXDP
 int shim_afxdp_bind(Shim*, const char*, uint32_t) { return -38; /*ENOSYS*/ }
-int shim_afxdp_poll(Shim*, uint32_t, uint64_t) { return -38; }
 #endif  // FLOWSHIM_HAVE_AFXDP
 
 }  // extern "C"

@@ -64,6 +64,9 @@ typedef struct ShimStats {
   uint64_t records_emitted;
   uint64_t verdict_drops;
   uint64_t verdict_passes;
+  // allowed frames lost because the tx ring was full (NIC backpressure) —
+  // counted separately from verdict_passes so tx loss is diagnosable
+  uint64_t tx_full_drops;
 } ShimStats;
 
 typedef struct Shim Shim;  // opaque
@@ -108,8 +111,41 @@ void shim_get_stats(const Shim* s, ShimStats* out);
 // without NET_ADMIN — callers fall back to the mock driver)
 // ---------------------------------------------------------------------------
 int shim_afxdp_bind(Shim* s, const char* ifname, uint32_t queue_id);
-// Drain up to ``budget`` frames from the AF_XDP rx ring into the batcher.
+// Drain up to ``budget`` frames from the rx ring into the batcher:
+// completion ring → fill ring recycle, then an rx descriptor walk handing
+// each umem frame to the parser. Works on the kernel-mapped rings after
+// shim_afxdp_bind OR on memory-mocked rings after shim_mock_rings_init.
+// Returns descriptors drained (>= 0) or -errno.
 int shim_afxdp_poll(Shim* s, uint32_t budget, uint64_t now_us);
+
+// XDP descriptor layout (mirror of the kernel's struct xdp_desc, declared
+// here so the ring logic and its memory-mocked tests compile anywhere).
+typedef struct ShimXdpDesc {
+  uint64_t addr;
+  uint32_t len;
+  uint32_t options;
+} ShimXdpDesc;
+
+// ---------------------------------------------------------------------------
+// Memory-mocked rings: the exact producer/consumer ring algebra of AF_XDP
+// (fill/completion/rx/tx single-producer single-consumer rings over a umem
+// frame pool) backed by heap memory, so the full frame lifecycle —
+// fill → (mock driver) rx → parse/batch → verdict → tx or fill-recycle →
+// completion → fill — is testable in an unprivileged container. The real
+// shim_afxdp_bind wires the same Ring views at kernel-mapped offsets.
+// ---------------------------------------------------------------------------
+int shim_mock_rings_init(Shim* s, uint32_t ring_size, uint32_t frame_size,
+                         uint32_t n_frames);
+// Mock NIC RX: take a frame from the fill ring, copy ``frame`` into its umem
+// slot, publish an rx descriptor. Returns 0, -ENOSPC (fill empty / rx full)
+// or -EMSGSIZE (frame larger than the umem chunk).
+int shim_mock_rx_inject(Shim* s, const uint8_t* frame, uint32_t len);
+// Mock NIC TX: consume up to ``max`` tx descriptors (the frames the shim
+// forwarded), then report them transmitted via the completion ring.
+uint32_t shim_mock_tx_drain(Shim* s, uint64_t* addrs, uint32_t* lens,
+                            uint32_t max);
+// Frames currently available in the fill ring (leak/accounting checks).
+uint32_t shim_ring_fill_level(const Shim* s);
 
 // ---------------------------------------------------------------------------
 // Service LB steering state (mirror of compile/lb.py's frontend hash table +

@@ -123,3 +123,120 @@ class TestMeshParity:
 
     def test_combined_4x2(self):
         _run_mesh_parity(4, 2, seed=8)
+
+
+# --------------------------------------------------------------------------- #
+# Production path: Engine + JITDatapath honoring n_shards/rule_shards
+# (round-4 verdict item 1: the mesh must be reachable from the Engine, not
+# just the dryrun). Runs on the conftest-provisioned 8-fake-device CPU mesh.
+# --------------------------------------------------------------------------- #
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.datapath import FakeDatapath, JITDatapath
+from cilium_tpu.parallel.mesh import rehash_ct_arrays
+from tests.test_datapath import TRAFFIC, fixture_engine
+
+
+def _sharded_cfg(**kw):
+    base = dict(ct_capacity=2048, auto_regen=False, n_shards=4,
+                rule_shards=2)
+    base.update(kw)
+    return DaemonConfig(**base)
+
+
+class TestShardedEngine:
+    def test_engine_sharded_parity_vs_fake(self):
+        """DaemonConfig(n_shards=4, rule_shards=2) engine serves through the
+        mesh and produces verdicts identical to the oracle-backed fake —
+        including CT continuity across batches (flow→shard steering must be
+        direction-stable)."""
+        eng_mesh = fixture_engine(JITDatapath(_sharded_cfg()))
+        eng_fake = fixture_engine(FakeDatapath(DaemonConfig(ct_capacity=2048)))
+        slots = eng_mesh.active.snapshot.ep_slot_of
+        assert slots == eng_fake.active.snapshot.ep_slot_of
+        now = 1000
+        for rep in range(3):          # repeats exercise ESTABLISHED via CT
+            batch = batch_from_records(TRAFFIC, slots)
+            out_m = eng_mesh.classify(dict(batch), now=now + rep * 5)
+            out_f = eng_fake.classify(dict(batch), now=now + rep * 5)
+            for k in ("allow", "reason", "status", "remote_identity",
+                      "redirect", "svc", "rnat"):
+                np.testing.assert_array_equal(
+                    np.asarray(out_f[k]), np.asarray(out_m[k]), (rep, k))
+        assert (np.asarray(out_m["status"])[0] == C.CTStatus.ESTABLISHED)
+        assert eng_mesh.ct_stats(now) == eng_fake.ct_stats(now)
+
+    def test_engine_sharded_random_traffic_parity(self):
+        """Random mixed traffic (both directions, replies of prior flows)
+        through the meshed engine == fake engine, multiple batches."""
+        rng = random.Random(11)
+        eng_mesh = fixture_engine(JITDatapath(_sharded_cfg()))
+        eng_fake = fixture_engine(FakeDatapath(DaemonConfig(ct_capacity=2048)))
+        slots = eng_mesh.active.snapshot.ep_slot_of
+        prior = []
+        now = 2000
+        for bi in range(4):
+            packets = [random_packet(rng, prior) for _ in range(100)]
+            batch = batch_from_records(packets, slots)
+            out_m = eng_mesh.classify(dict(batch), now=now)
+            out_f = eng_fake.classify(dict(batch), now=now)
+            for k in ("allow", "reason", "status", "remote_identity"):
+                np.testing.assert_array_equal(
+                    np.asarray(out_f[k]), np.asarray(out_m[k]), (bi, k))
+            prior.extend(p for i, p in enumerate(packets)
+                         if out_f["allow"][i]
+                         and out_f["status"][i] == C.CTStatus.NEW)
+            prior = prior[-120:]
+            now += 30
+
+    def test_ct_checkpoint_across_shard_layouts(self):
+        """CT exported from a sharded backend restores into a single-chip
+        backend and vice versa: flows stay ESTABLISHED (rehash_ct_arrays
+        re-places entries for the importing geometry)."""
+        eng_mesh = fixture_engine(JITDatapath(_sharded_cfg()))
+        slots = eng_mesh.active.snapshot.ep_slot_of
+        batch = batch_from_records(TRAFFIC, slots)
+        out0 = eng_mesh.classify(dict(batch), now=1000)
+        live = eng_mesh.ct_stats(1000)["live"]
+        assert live > 0
+        arrays = eng_mesh.ct_arrays()
+
+        # mesh → single chip
+        eng_one = fixture_engine(JITDatapath(DaemonConfig(
+            ct_capacity=2048, auto_regen=False)))
+        eng_one.load_ct_arrays(arrays)
+        assert eng_one.ct_stats(1000)["live"] == live
+        out1 = eng_one.classify(dict(batch), now=1005)
+        allowed = np.asarray(out0["allow"])
+        assert (np.asarray(out1["status"])[allowed]
+                == C.CTStatus.ESTABLISHED).all()
+
+        # single chip → mesh (different flow-shard count: 2)
+        arrays1 = eng_one.ct_arrays()
+        eng_mesh2 = fixture_engine(JITDatapath(_sharded_cfg(n_shards=2,
+                                                            rule_shards=1)))
+        eng_mesh2.load_ct_arrays(arrays1)
+        out2 = eng_mesh2.classify(dict(batch), now=1010)
+        assert (np.asarray(out2["status"])[allowed]
+                == C.CTStatus.ESTABLISHED).all()
+
+    def test_rehash_preserves_entries(self):
+        """rehash round trip: every live entry survives (ample probe room)
+        and lands where the importing geometry's probe expects it —
+        asserted behaviorally above, structurally here."""
+        rng = np.random.default_rng(5)
+        from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+        arrays = make_ct_arrays(CTConfig(capacity=1024))
+        n = 200
+        arrays["keys"][:n] = rng.integers(0, 2**32, (n, 10), dtype=np.uint32)
+        arrays["keys"][:n, 9] = (arrays["keys"][:n, 9] & ~np.uint32(0xFF)) \
+            | (arrays["keys"][:n, 9] & 1)          # direction ∈ {0,1}
+        arrays["expiry"][:n] = 5000
+        arrays["pkts_fwd"][:n] = np.arange(n)
+        re4, dropped = rehash_ct_arrays(arrays, 4)
+        assert dropped == 0
+        assert int((re4["expiry"] > 0).sum()) == n
+        # entry payloads survive keyed by key (slots differ)
+        src = {tuple(arrays["keys"][i]): int(arrays["pkts_fwd"][i])
+               for i in range(n)}
+        for s in np.nonzero(re4["expiry"] > 0)[0]:
+            assert src[tuple(re4["keys"][s])] == int(re4["pkts_fwd"][s])

@@ -282,3 +282,142 @@ class TestGoldengen:
                                        world_index=snap.world_index)
             assert bool(np.asarray(out["allow"])[0]) == bool(golden.allow[i]), (i, p)
             assert int(np.asarray(out["reason"])[0]) == int(golden.reason[i]), (i, p)
+
+
+class TestAfxdpRings:
+    """The ring-draining packet path on memory-mocked AF_XDP rings: the same
+    producer/consumer algebra shim_afxdp_bind maps from the kernel, backed by
+    heap memory so the full frame lifecycle runs unprivileged:
+    fill → (mock NIC) rx → shim_afxdp_poll parse/batch → verdicts →
+    tx (forward) or fill (drop) → completion → fill."""
+
+    def _ring_shim(self, n_frames=32, ring_size=32):
+        from cilium_tpu.shim.bindings import FlowShim
+        s = FlowShim(batch_size=16, timeout_us=1000)
+        s.register_endpoint("192.168.1.10", 1)
+        s.mock_rings_init(ring_size=ring_size, frame_size=2048,
+                          n_frames=n_frames)
+        return s
+
+    def test_rx_drain_parse_and_batch(self):
+        from cilium_tpu.shim.bindings import build_frame
+        s = self._ring_shim()
+        assert s.ring_fill_level() == 32
+        for i in range(8):
+            assert s.mock_rx_inject(build_frame(
+                "192.168.1.10", f"10.0.0.{i}", 40000 + i, 443)) == 0
+        assert s.ring_fill_level() == 24          # 8 frames now in rx
+        drained = s.afxdp_poll(budget=256)
+        assert drained == 8
+        b = s.poll_batch(force=True)
+        assert b is not None
+        assert int(b["valid"].sum()) == 8
+        assert (b["dport"][:8] == 443).all()
+        s.close()
+
+    def test_verdict_enforcement_tx_and_recycle(self):
+        from cilium_tpu.shim.bindings import build_frame
+        s = self._ring_shim()
+        for i in range(6):
+            s.mock_rx_inject(build_frame("192.168.1.10", f"10.0.0.{i}",
+                                         41000 + i, 443))
+        s.afxdp_poll()
+        b = s.poll_batch(force=True)
+        allow = np.zeros(16, dtype=bool)
+        allow[:3] = True                          # pass 3, drop 3
+        s.apply_verdicts(allow[: int(b["valid"].sum())])
+        # dropped frames recycled straight to fill: 32 - 6 + 3 = 29
+        assert s.ring_fill_level() == 29
+        # passed frames sit in the tx ring; the mock NIC transmits them
+        txed = s.mock_tx_drain()
+        assert len(txed) == 3
+        assert all(ln > 0 for _a, ln in txed)
+        # completion → fill recycle happens on the next poll
+        s.afxdp_poll()
+        assert s.ring_fill_level() == 32          # no frame leaked
+        st = s.stats()
+        assert st["verdict_passes"] == 3 and st["verdict_drops"] == 3
+        s.close()
+
+    def test_parse_error_frames_recycle(self):
+        s = self._ring_shim()
+        assert s.mock_rx_inject(b"\x00" * 10) == 0   # runt frame
+        assert s.afxdp_poll() == 1
+        assert s.stats()["parse_errors"] == 1
+        assert s.ring_fill_level() == 32             # recycled immediately
+        s.close()
+
+    def test_fill_exhaustion_backpressure(self):
+        from cilium_tpu.shim.bindings import build_frame
+        s = self._ring_shim(n_frames=4, ring_size=4)
+        f = build_frame("192.168.1.10", "10.0.0.1", 40000, 443)
+        for _ in range(4):
+            assert s.mock_rx_inject(f) == 0
+        import errno
+        assert s.mock_rx_inject(f) == -errno.ENOSPC   # no free frames
+        s.afxdp_poll()
+        b = s.poll_batch(force=True)
+        s.apply_verdicts(np.zeros(int(b["valid"].sum()), dtype=bool))
+        assert s.ring_fill_level() == 4               # all recycled
+        s.close()
+
+    def test_ring_path_to_classifier_parity(self):
+        """End-to-end: mocked NIC frames → ring drain → batch → jit classify
+        → verdict bitmap → enforcement. The ring path must produce the same
+        records (and therefore verdicts) as the direct feed_frame path."""
+        import jax.numpy as jnp
+        from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
+        from cilium_tpu.compile.snapshot import build_snapshot
+        from cilium_tpu.kernels.classify import make_classify_fn
+        from cilium_tpu.model.endpoint import Endpoint
+        from cilium_tpu.model.identity import IdentityAllocator
+        from cilium_tpu.model.ipcache import IPCache
+        from cilium_tpu.model.labels import Labels
+        from cilium_tpu.model.rules import parse_rule
+        from cilium_tpu.policy import PolicyContext, Repository
+        from cilium_tpu.policy.selectorcache import SelectorCache
+        from cilium_tpu.shim.bindings import build_frame
+
+        alloc = IdentityAllocator()
+        ctx = PolicyContext(allocator=alloc,
+                            selector_cache=SelectorCache(alloc),
+                            ipcache=IPCache())
+        repo = Repository(ctx)
+        lbls = Labels.parse(["k8s:app=web"])
+        ident = alloc.allocate(lbls)
+        ctx.ipcache.upsert("192.168.1.10/32", ident.id)
+        ep = Endpoint(ep_id=1, labels=lbls, identity_id=ident.id)
+        repo.add([parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toCIDR": ["10.0.0.0/8"],
+                        "toPorts": [{"ports": [
+                            {"port": "443", "protocol": "TCP"}]}]}]})])
+        snap = build_snapshot(repo, ctx, [ep], CTConfig(capacity=1024))
+        tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+        ct = {k: jnp.asarray(v) for k, v in
+              make_ct_arrays(CTConfig(capacity=1024)).items()}
+        fn = make_classify_fn(donate_ct=False)
+
+        s = self._ring_shim()
+        # 443 to 10/8 allowed; 80 dropped; dst outside 10/8 dropped
+        frames = [build_frame("192.168.1.10", "10.1.1.1", 40000, 443),
+                  build_frame("192.168.1.10", "10.1.1.1", 40001, 80),
+                  build_frame("192.168.1.10", "11.1.1.1", 40002, 443)]
+        for f in frames:
+            assert s.mock_rx_inject(f) == 0
+        s.afxdp_poll()
+        b = s.poll_batch(force=True)
+        # ep_id raw → slot mapping (bindings leave it to the caller)
+        b["ep_slot"][:] = 0
+        b["valid"] = b.pop("_ep_raw") != 0
+        b.pop("_frame_idx")
+        dev = {k: jnp.asarray(v) for k, v in b.items()}
+        out, _ct2, _ctr = fn(tensors, ct, dev,
+                             jnp.uint32(100), jnp.int32(snap.world_index))
+        allow = np.asarray(out["allow"])[: 16]
+        assert bool(allow[0]) and not bool(allow[1]) and not bool(allow[2])
+        s.apply_verdicts(allow[:3])
+        assert len(s.mock_tx_drain()) == 1            # only the allowed one
+        s.afxdp_poll()
+        assert s.ring_fill_level() == 32
+        s.close()
