@@ -85,6 +85,36 @@ def build_config1(preset):
                           + rng.integers(0, 1 << 24, n)).astype(np.uint32)
         b["dport"][:] = rng.integers(1, 65535, n)
         return b
+
+    def pcap_replay(batch, count):
+        """BASELINE cfg1 'IPv4-only 5-tuple pcap replay': frames through the
+        C++ parser/batcher (the AF_XDP ingest path), not a numpy generator.
+        Returns None (→ numpy fallback) if the shim isn't built."""
+        import os
+        import tempfile
+        from cilium_tpu.shim.bindings import LIB_PATH
+        if not os.path.exists(LIB_PATH):
+            return None
+        from cilium_tpu.shim.bindings import FlowShim
+        from cilium_tpu.shim.pcap import replay_pcap, synthesize_pcap
+        fd, path = tempfile.mkstemp(suffix=".pcap")
+        os.close(fd)
+        try:
+            synthesize_pcap(path, batch * count)
+            shim = FlowShim(batch_size=batch, timeout_us=0)
+            shim.register_endpoint("192.168.0.10", 1)
+            batches = replay_pcap(shim, path, batch, max_batches=count)
+            shim.close()
+        finally:
+            os.unlink(path)
+        for b in batches:
+            raw = b.pop("_ep_raw")
+            b.pop("_frame_idx")
+            b["ep_slot"][:] = 0              # single endpoint at slot 0
+            b["valid"] = raw != 0
+        return batches
+
+    gen.pcap_replay = pcap_replay
     return snap, gen, True  # v4_only
 
 
@@ -373,7 +403,8 @@ METRIC_NAMES = {
 # --------------------------------------------------------------------------- #
 def run_bench(config: int, preset: str, batch: int, batches: int,
               verbose: bool = False, windows: int = 5,
-              shards: int = 1, rule_shards: int = 1):
+              shards: int = 1, rule_shards: int = 1,
+              profile_dir: str = ""):
     """One config → throughput dict.
 
     Pipeline modeled: packed wire batches (kernels/records.pack_batch — the
@@ -414,7 +445,13 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     # pre-generate host batches (generation excluded from the timed loop —
     # the shim does it in C++; transfer included, it is part of the real
     # pipeline). One packed width per config so a single jit serves.
-    host_dicts = [gen(rng, batch) for _ in range(min(batches, 16))]
+    # Configs with a pcap source replay it through the shim ingest instead.
+    host_dicts = None
+    pcap_fn = getattr(gen, "pcap_replay", None)
+    if pcap_fn is not None:
+        host_dicts = pcap_fn(batch, min(batches, 16))
+    if host_dicts is None:
+        host_dicts = [gen(rng, batch) for _ in range(min(batches, 16))]
     from cilium_tpu.utils import constants as C
     from cilium_tpu.kernels.records import pack_batch_v4
 
@@ -481,6 +518,16 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     eff_batch = batch          # valid records per batch (steered pads aren't)
 
     # -- mode 1: transfer-included (headline) ------------------------------- #
+    if profile_dir:
+        # one profiled steady-state window → XProf trace (SURVEY §5)
+        with jax.profiler.trace(profile_dir):
+            for i in range(min(batches, 8)):
+                now += 1
+                out, ct, counters = fn(
+                    tensors, ct, jax.device_put(host_batches[i % len(host_batches)]),
+                    jnp.uint32(now), wi)
+            jax.block_until_ready(out)
+        print(f"# profiler trace written to {profile_dir}", file=sys.stderr)
     xfer_tp = []
     for _w in range(windows):
         nxt = jax.device_put(host_batches[0])
@@ -587,6 +634,9 @@ def main(argv=None):
                     help="verdict-row shards (rule-space mesh axis)")
     ap.add_argument("--windows", type=int, default=5,
                     help="timing windows per mode (median+IQR reported)")
+    ap.add_argument("--profile", default="", metavar="DIR",
+                    help="write an XProf trace of one steady-state window "
+                         "to DIR (jax.profiler.trace)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -615,7 +665,8 @@ def main(argv=None):
 
     result = run_bench(args.config, preset, batch, batches,
                        verbose=args.verbose, windows=args.windows,
-                       shards=args.shards, rule_shards=args.rule_shards)
+                       shards=args.shards, rule_shards=args.rule_shards,
+                       profile_dir=args.profile)
     if args.shards * args.rule_shards > 1:
         args.only = True       # the sweep is a single-chip comparison series
     if not args.only:
@@ -626,10 +677,11 @@ def main(argv=None):
         for cfg in sorted(BUILDERS):
             if cfg == args.config:
                 continue
-            # non-headline configs: fewer timed batches (visibility, not the
-            # headline number) so the whole sweep stays bounded
+            # non-headline configs: fewer timed batches and windows
+            # (visibility, not the headline number) — bounds the sweep
             res = run_bench(cfg, preset, batch, max(10, batches // 2),
-                            verbose=args.verbose, windows=args.windows)
+                            verbose=args.verbose,
+                            windows=max(3, args.windows - 2))
             print(json.dumps(res), file=sys.stderr)
             configs[METRIC_NAMES[cfg]] = {
                 "value": res["value"], "vs_baseline": res["vs_baseline"],

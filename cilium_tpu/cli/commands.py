@@ -82,9 +82,12 @@ def register(sub: "argparse._SubParsersAction") -> None:
 
     p = sub.add_parser(
         "monitor", help="flow log viewer (cilium monitor / hubble observe)")
-    p.add_argument("--flowlog-path", required=True,
+    p.add_argument("--flowlog-path",
                    help="JSONL sink written by the engine "
                         "(DaemonConfig.flowlog_path)")
+    p.add_argument("--api", metavar="SOCKET",
+                   help="live mode: read the in-memory flow ring of a "
+                        "running engine over its REST socket")
     p.add_argument("--last", type=int, default=50)
     p.add_argument("--verdict", choices=["FORWARDED", "DROPPED"])
     p.add_argument("--endpoint", type=int)
@@ -98,9 +101,22 @@ def register(sub: "argparse._SubParsersAction") -> None:
 
     p = sub.add_parser("metrics", help="print the Prometheus text file the "
                                        "engine exports")
-    p.add_argument("--metrics-path", required=True,
+    p.add_argument("--metrics-path",
                    help="DaemonConfig.metrics_path file")
+    p.add_argument("--api", metavar="SOCKET",
+                   help="live mode: scrape a running engine's REST socket")
     p.set_defaults(func=_cmd_metrics)
+
+    p = sub.add_parser(
+        "verify", help="compile every datapath config combo and check the "
+                       "memory budget (XLA-as-verifier; the test/verifier "
+                       "CI-step analog)")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--max-hbm-bytes", type=int, default=None,
+                   help="fail combos whose argument+temp memory exceeds this")
+    p.add_argument("--quick", action="store_true",
+                   help="skip the LB axis (faster pre-merge check)")
+    p.set_defaults(func=_cmd_verify)
 
     p = sub.add_parser(
         "map", help="compiled policy-map inspection (cilium bpf policy get)")
@@ -114,15 +130,40 @@ def register(sub: "argparse._SubParsersAction") -> None:
 
 
 def _add_state_dir(p):
-    p.add_argument("--state-dir", required=True,
+    p.add_argument("--state-dir",
                    help="checkpoint dir written by the engine "
                         "(the /var/run/cilium analog)")
+    p.add_argument("--api", metavar="SOCKET",
+                   help="live mode: query a running engine's REST API on "
+                        "this unix socket instead of reading state files "
+                        "(DaemonConfig.api_socket)")
     p.add_argument("-o", "--output", choices=["text", "json"], default="text")
 
 
 def _load(args):
+    if not getattr(args, "state_dir", None):
+        raise SystemExit("one of --state-dir or --api is required")
     from cilium_tpu.runtime.checkpoint import load_host
     return load_host(args.state_dir)
+
+
+def _live(args, method: str, path: str, body=None):
+    """Fetch one route from a running engine (--api SOCKET live mode)."""
+    from cilium_tpu.runtime.api import UnixAPIClient
+    status, doc = UnixAPIClient(args.api).request(method, path, body)
+    if status != 200:
+        print(f"API error {status}: {doc}", file=sys.stderr)
+        raise SystemExit(1)
+    return doc
+
+
+def _live_emit(args, method: str, path: str, body=None, text_fn=None) -> int:
+    doc = _live(args, method, path, body)
+    if args.output == "json" or text_fn is None:
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        text_fn(doc)
+    return 0
 
 
 def _emit(args, doc, text_fn) -> int:
@@ -150,6 +191,20 @@ def _cmd_version(args) -> int:
 
 
 def _cmd_status(args) -> int:
+    def text(d):
+        print(f"Policy revision:  {d['revision']}")
+        print(f"Endpoints:        {d['endpoints']}")
+        print(f"Identities:       {d['identities']}")
+        print(f"Rules:            {d['rules']}")
+        print(f"IPCache entries:  {d['ipcache_entries']}")
+        print(f"Services:         {d['services']}")
+        if d["conntrack"]:
+            print(f"Conntrack:        {d['conntrack']['live']}/"
+                  f"{d['conntrack']['capacity']} live")
+        print(f"Enforcement:      {d['enforcement_mode']}")
+
+    if args.api:
+        return _live_emit(args, "GET", "/v1/status", text_fn=text)
     st = _load(args)
     ct_doc = None
     if st.ct is not None:
@@ -167,37 +222,29 @@ def _cmd_status(args) -> int:
         "conntrack": ct_doc,
         "enforcement_mode": st.ctx.enforcement_mode,
     }
-
-    def text(d):
-        print(f"Policy revision:  {d['revision']}")
-        print(f"Endpoints:        {d['endpoints']}")
-        print(f"Identities:       {d['identities']}")
-        print(f"Rules:            {d['rules']}")
-        print(f"IPCache entries:  {d['ipcache_entries']}")
-        print(f"Services:         {d['services']}")
-        if d["conntrack"]:
-            print(f"Conntrack:        {d['conntrack']['live']}/"
-                  f"{d['conntrack']['capacity']} live")
-        print(f"Enforcement:      {d['enforcement_mode']}")
     return _emit(args, doc, text)
 
 
 def _cmd_endpoint_list(args) -> int:
-    st = _load(args)
-    doc = [{"ep_id": ep.ep_id, "identity": ep.identity_id,
-            "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
-            "enforcement": ep.enforcement}
-           for ep in sorted(st.endpoints.values(), key=lambda e: e.ep_id)]
-
     def text(d):
         for e in d:
             print(f"{e['ep_id']:<6} id={e['identity']:<8} "
                   f"ips={','.join(e['ips']) or '-':<24} "
                   f"labels={','.join(e['labels'])}")
+
+    if args.api:
+        return _live_emit(args, "GET", "/v1/endpoints", text_fn=text)
+    st = _load(args)
+    doc = [{"ep_id": ep.ep_id, "identity": ep.identity_id,
+            "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
+            "enforcement": ep.enforcement}
+           for ep in sorted(st.endpoints.values(), key=lambda e: e.ep_id)]
     return _emit(args, doc, text)
 
 
 def _cmd_endpoint_get(args) -> int:
+    if args.api:
+        return _live_emit(args, "GET", f"/v1/endpoints/{args.ep_id}")
     st = _load(args)
     ep = st.endpoints.get(args.ep_id)
     if ep is None:
@@ -218,6 +265,14 @@ def _cmd_endpoint_get(args) -> int:
 
 
 def _cmd_identity_list(args) -> int:
+    def text(d):
+        for e in d:
+            kind = ("reserved" if e["reserved"]
+                    else "cidr" if e["local"] else "cluster")
+            print(f"{e['id']:<10} {kind:<9} {','.join(e['labels'])}")
+
+    if args.api:
+        return _live_emit(args, "GET", "/v1/identities", text_fn=text)
     st = _load(args)
     doc = []
     for ident in st.ctx.allocator.all():
@@ -225,16 +280,12 @@ def _cmd_identity_list(args) -> int:
                     "labels": list(ident.labels.to_strings()),
                     "reserved": ident.id < C.CLUSTER_IDENTITY_BASE,
                     "local": bool(ident.id & C.LOCAL_IDENTITY_SCOPE)})
-
-    def text(d):
-        for e in d:
-            kind = ("reserved" if e["reserved"]
-                    else "cidr" if e["local"] else "cluster")
-            print(f"{e['id']:<10} {kind:<9} {','.join(e['labels'])}")
     return _emit(args, doc, text)
 
 
 def _cmd_policy_get(args) -> int:
+    if args.api:
+        return _live_emit(args, "GET", "/v1/policy")
     st = _load(args)
     doc = [r.raw for r in st.repo.all_rules() if r.raw is not None]
     return _emit(args, doc, lambda d: print(json.dumps(d, indent=2)))
@@ -253,6 +304,11 @@ def _key_str(key) -> str:
 
 
 def _cmd_policy_trace(args) -> int:
+    if args.api:
+        return _live_emit(args, "POST", "/v1/policy/trace", body={
+            "ep": args.ep, "direction": args.direction,
+            "remote": args.remote, "dport": args.dport,
+            "proto": args.proto})
     st = _load(args)
     ep = st.endpoints.get(args.ep)
     if ep is None:
@@ -312,6 +368,8 @@ def _cmd_policy_trace(args) -> int:
 
 
 def _cmd_service_list(args) -> int:
+    if args.api:
+        return _live_emit(args, "GET", "/v1/services")
     st = _load(args)
     doc = []
     for svc in st.ctx.services.all():
@@ -335,6 +393,8 @@ def _cmd_service_list(args) -> int:
 
 
 def _cmd_fqdn_cache(args) -> int:
+    if args.api:
+        return _live_emit(args, "GET", "/v1/fqdn/cache")
     st = _load(args)
     doc = [{"name": name, "ips": {ip: exp for ip, exp in sorted(e.items())}}
            for name, e in st.ctx.fqdn_cache.names()]
@@ -348,6 +408,11 @@ def _cmd_fqdn_cache(args) -> int:
 
 
 def _cmd_ct_list(args) -> int:
+    if args.api:
+        path = f"/v1/ct?limit={args.limit}"
+        if args.now is not None:
+            path += f"&now={args.now}"
+        return _live_emit(args, "GET", path)
     import numpy as np
     from cilium_tpu.utils.ip import addr_to_str, words_to_addr
     st = _load(args)
@@ -414,9 +479,6 @@ def _flow_line(r: dict) -> str:
 
 def _cmd_monitor(args) -> int:
     import time as _time
-    if not os.path.exists(args.flowlog_path):
-        print(f"no flow log at {args.flowlog_path}", file=sys.stderr)
-        return 1
 
     def emit(records):
         if args.output == "json":
@@ -425,6 +487,27 @@ def _cmd_monitor(args) -> int:
         else:
             for r in records:
                 print(_flow_line(r), flush=args.follow)
+
+    if args.api:
+        from cilium_tpu.runtime.api import UnixAPIClient
+        client = UnixAPIClient(args.api)
+        path = f"/v1/flows?last={args.last}"
+        if args.verdict:
+            path += f"&verdict={args.verdict}"
+        if args.endpoint is not None:
+            path += f"&endpoint={args.endpoint}"
+        status, records = client.get(path)
+        if status != 200:
+            print(f"API error {status}: {records}", file=sys.stderr)
+            return 1
+        emit([r for r in records if _flow_matches(r, args)])
+        return 0
+    if not args.flowlog_path:
+        print("one of --flowlog-path or --api is required", file=sys.stderr)
+        return 1
+    if not os.path.exists(args.flowlog_path):
+        print(f"no flow log at {args.flowlog_path}", file=sys.stderr)
+        return 1
 
     with open(args.flowlog_path) as f:
         records = []
@@ -458,6 +541,17 @@ def _cmd_monitor(args) -> int:
 
 
 def _cmd_metrics(args) -> int:
+    if args.api:
+        from cilium_tpu.runtime.api import UnixAPIClient
+        status, text = UnixAPIClient(args.api).get("/v1/metrics")
+        if status != 200:
+            print(f"API error {status}: {text}", file=sys.stderr)
+            return 1
+        sys.stdout.write(text)
+        return 0
+    if not args.metrics_path:
+        print("one of --metrics-path or --api is required", file=sys.stderr)
+        return 1
     if not os.path.exists(args.metrics_path):
         print(f"no metrics file at {args.metrics_path}", file=sys.stderr)
         return 1
@@ -466,7 +560,27 @@ def _cmd_metrics(args) -> int:
     return 0
 
 
+def _cmd_verify(args) -> int:
+    from cilium_tpu.compile.verifier import verify_configs
+    reports = verify_configs(batch=args.batch,
+                             max_hbm_bytes=args.max_hbm_bytes,
+                             quick=args.quick)
+    bad = 0
+    for r in reports:
+        mem = (f"arg={r.argument_bytes} temp={r.temp_bytes} "
+               f"out={r.output_bytes}" if r.ok else r.error)
+        print(f"{'OK  ' if r.ok else 'FAIL'} {r.name:<24} {mem}")
+        bad += not r.ok
+    print(f"{len(reports) - bad}/{len(reports)} combos verifier-accepted")
+    return 1 if bad else 0
+
+
 def _cmd_map_get(args) -> int:
+    if getattr(args, "api", None):
+        print("map get reads compiled MapState detail from a checkpoint; "
+              "use --state-dir (or `endpoint get --api` for live policy "
+              "sizes)", file=sys.stderr)
+        return 1
     st = _load(args)
     ep = st.endpoints.get(args.ep)
     if ep is None:

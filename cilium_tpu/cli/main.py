@@ -16,8 +16,9 @@ def main(argv=None) -> int:
         description="TPU-native packet-classification framework CLI",
     )
     sub = parser.add_subparsers(dest="command")
-    from cilium_tpu.cli import commands
+    from cilium_tpu.cli import agent, commands
     commands.register(sub)
+    agent.register(sub)
     args = parser.parse_args(argv)
     if not args.command:
         parser.print_help()

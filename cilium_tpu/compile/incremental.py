@@ -250,7 +250,6 @@ class IncrementalCompiler:
     # the update entry point
     # ------------------------------------------------------------------ #
     def try_update(self, ct_config: Optional[CTConfig] = None,
-                   lb_config=None,
                    endpoints: Optional[Sequence[Endpoint]] = None
                    ) -> Optional[Tuple[PolicySnapshot, SnapshotPatch,
                                        UpdateStats]]:
@@ -377,7 +376,7 @@ class IncrementalCompiler:
             patch.full_tensors.update(
                 ("l7_methods", "l7_path", "l7_path_len", "l7_valid"))
 
-        snap = self._emit(rev_now, ct_config, lb_config, l7_dirty)
+        snap = self._emit(rev_now, ct_config, l7_dirty)
         self.base = snap
         return snap, patch, stats
 
@@ -557,7 +556,7 @@ class IncrementalCompiler:
     # ------------------------------------------------------------------ #
     # snapshot emission
     # ------------------------------------------------------------------ #
-    def _emit(self, revision: int, ct_config, lb_config,
+    def _emit(self, revision: int, ct_config,
               l7_dirty: bool) -> PolicySnapshot:
         base = self.base
         image = PolicyImage(verdict=self._verdict, enforced=self._enforced)

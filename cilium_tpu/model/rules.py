@@ -309,8 +309,24 @@ def _parse_block(obj: Dict, direction: str, deny: bool) -> RuleBlock:
 
 
 def parse_rule(obj: Dict) -> Rule:
+    """Parse one CNP-style rule document. Total over JSON values: returns a
+    Rule or raises RuleParseError — rule documents are an untrusted input
+    path (upstream fuzzes pkg/policy/api for the same reason), so malformed
+    shapes must never escape as KeyError/TypeError."""
+    if not isinstance(obj, dict):
+        raise RuleParseError(
+            f"rule document must be an object, got {type(obj).__name__}")
     if "endpointSelector" not in obj:
         raise RuleParseError("rule missing endpointSelector")
+    try:
+        return _parse_rule_checked(obj)
+    except RuleParseError:
+        raise
+    except (KeyError, TypeError, AttributeError, ValueError) as e:
+        raise RuleParseError(f"malformed rule document: {e!r}") from e
+
+
+def _parse_rule_checked(obj: Dict) -> Rule:
     return Rule(
         endpoint_selector=EndpointSelector.from_json(obj["endpointSelector"]),
         ingress=tuple(_parse_block(b, "ingress", False)

@@ -49,18 +49,44 @@ class EndpointSelector:
     match_labels: Tuple[Tuple[str, str], ...] = ()
     match_expressions: Tuple[MatchExpression, ...] = ()
 
+    _OPERATORS = ("In", "NotIn", "Exists", "DoesNotExist")
+
     @classmethod
     def from_json(cls, obj: Optional[Dict]) -> "EndpointSelector":
+        """Strict parse: raises ValueError on malformed selectors (the rule
+        parser converts to RuleParseError at its boundary) — hostile CNP
+        documents must never escape as KeyError/TypeError (fuzz contract,
+        tests/test_fuzz.py)."""
         if obj is None:
             return cls()
-        ml = tuple(sorted((k, v) for k, v in (obj.get("matchLabels") or {}).items()))
+        if not isinstance(obj, dict):
+            raise ValueError(f"selector must be an object, got "
+                             f"{type(obj).__name__}")
+        raw_ml = obj.get("matchLabels") or {}
+        if not isinstance(raw_ml, dict):
+            raise ValueError("matchLabels must be an object")
+        for k, v in raw_ml.items():
+            if not isinstance(k, str) or not isinstance(v, str):
+                raise ValueError("matchLabels keys/values must be strings")
+        ml = tuple(sorted(raw_ml.items()))
         mes: List[MatchExpression] = []
-        for e in obj.get("matchExpressions") or []:
+        raw_mes = obj.get("matchExpressions") or []
+        if not isinstance(raw_mes, (list, tuple)):
+            raise ValueError("matchExpressions must be a list")
+        for e in raw_mes:
+            if not isinstance(e, dict):
+                raise ValueError("matchExpressions entry must be an object")
+            if "key" not in e or not isinstance(e["key"], str):
+                raise ValueError("matchExpressions entry requires a "
+                                 "string 'key'")
+            op = e.get("operator")
+            if op not in cls._OPERATORS:
+                raise ValueError(f"unknown matchExpressions operator {op!r}")
+            values = e.get("values") or ()
+            if not all(isinstance(v, str) for v in values):
+                raise ValueError("matchExpressions values must be strings")
             mes.append(MatchExpression(
-                key=e["key"],
-                operator=e["operator"],
-                values=tuple(e.get("values") or ()),
-            ))
+                key=e["key"], operator=op, values=tuple(values)))
         return cls(match_labels=ml, match_expressions=tuple(mes))
 
     @classmethod

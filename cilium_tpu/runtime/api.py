@@ -1,0 +1,397 @@
+"""Slim REST API over a unix socket (SURVEY.md §1 layer 7 "slim REST/gRPC
+analog"; upstream: the cilium-agent `api/v1` go-swagger server on
+/var/run/cilium/cilium.sock — §3.1 "api server up (unix socket REST)").
+
+Stdlib-only (http.server over a Unix stream socket), JSON bodies, thread-per
+-connection. The handlers read the LIVE engine — this is the difference from
+the offline CLI, which reads checkpoint files; `cilium-tpu --api <sock>`
+drives these routes (cli/commands.py).
+
+Routes (all under /v1):
+  GET  /v1/healthz            liveness + policy revision
+  GET  /v1/status             agent summary (endpoints/identities/rules/CT)
+  GET  /v1/endpoints          endpoint list
+  GET  /v1/endpoints/<id>     one endpoint incl. per-direction policy size
+  GET  /v1/identities         identity list
+  GET  /v1/policy             rule documents
+  POST /v1/policy             apply CNP-style rule documents (returns revision)
+  POST /v1/policy/trace       {ep, direction, remote, dport, proto} → verdict
+  GET  /v1/services           service/LB state
+  GET  /v1/ct?limit=N&now=T   live conntrack entries
+  GET  /v1/flows?last=N&verdict=V   flow log tail
+  GET  /v1/fqdn/cache         learned DNS names
+  GET  /v1/metrics            Prometheus text (text/plain)
+  GET  /v1/config             daemon config echo (runtime-mutable subset)
+  PATCH /v1/config            {"enforcement_mode": ...} (upstream: `cilium
+                              config PolicyEnforcement=...`)
+  GET  /v1/health             datapath health probe through real classify
+  POST /v1/regenerate         force a recompile
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
+
+from cilium_tpu.utils import constants as C
+
+if TYPE_CHECKING:
+    from cilium_tpu.runtime.engine import Engine
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class APIServer:
+    """Owns the unix socket + serving thread; route logic in _Handler."""
+
+    def __init__(self, engine: "Engine", socket_path: str):
+        self.engine = engine
+        self.socket_path = socket_path
+        self._server: Optional[_UnixHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        d = os.path.dirname(self.socket_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)          # stale socket from a crash
+        engine = self.engine
+
+        class Handler(_Handler):
+            pass
+
+        Handler.engine = engine
+        self._server = _UnixHTTPServer(self.socket_path, Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="cilium-tpu-api", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+
+# --------------------------------------------------------------------------- #
+# document builders (shapes shared with the offline CLI so the same text
+# renderers work on live and checkpoint data)
+# --------------------------------------------------------------------------- #
+def status_doc(engine: "Engine") -> Dict:
+    import time
+    now = int(time.time())
+    ct = engine.ct_stats(now)
+    return {
+        "revision": engine.repo.revision,
+        "endpoints": len(engine.endpoints),
+        "identities": len(list(engine.ctx.allocator.all())),
+        "rules": len(engine.repo),
+        "ipcache_entries": len(engine.ctx.ipcache.snapshot()),
+        "services": len(engine.ctx.services.all()),
+        "conntrack": {"capacity": ct["capacity"], "live": ct["live"]},
+        "enforcement_mode": engine.ctx.enforcement_mode,
+    }
+
+
+def endpoints_doc(engine: "Engine"):
+    return [{"ep_id": ep.ep_id, "identity": ep.identity_id,
+             "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
+             "enforcement": ep.enforcement,
+             "policy_revision": ep.policy_revision}
+            for ep in sorted(engine.endpoints.values(),
+                             key=lambda e: e.ep_id)]
+
+
+def endpoint_doc(engine: "Engine", ep_id: int) -> Optional[Dict]:
+    ep = engine.endpoints.get(ep_id)
+    if ep is None:
+        return None
+    pol = engine.repo.resolve(ep)
+    return {
+        "ep_id": ep.ep_id, "identity": ep.identity_id,
+        "ips": list(ep.ips), "labels": list(ep.labels.to_strings()),
+        "enforcement": ep.enforcement,
+        "policy_revision": pol.revision,
+        "egress": {"enforced": pol.egress.enforced,
+                   "entries": len(pol.egress.mapstate.items())},
+        "ingress": {"enforced": pol.ingress.enforced,
+                    "entries": len(pol.ingress.mapstate.items())},
+    }
+
+
+def identities_doc(engine: "Engine"):
+    return [{"id": ident.id, "labels": list(ident.labels.to_strings()),
+             "reserved": ident.id < C.CLUSTER_IDENTITY_BASE,
+             "local": bool(ident.id & C.LOCAL_IDENTITY_SCOPE)}
+            for ident in engine.ctx.allocator.all()]
+
+
+def services_doc(engine: "Engine"):
+    return [{"name": s.name, "namespace": s.namespace,
+             "backends": list(s.backends),
+             "frontends": [{"addr": f.addr, "port": f.port,
+                            "proto": f.proto, "kind": f.kind}
+                           for f in s.frontends]}
+            for s in engine.ctx.services.all()]
+
+
+def fqdn_doc(engine: "Engine"):
+    return [{"name": name, "ips": {ip: exp for ip, exp in sorted(e.items())}}
+            for name, e in engine.ctx.fqdn_cache.names()]
+
+
+def ct_doc(engine: "Engine", limit: int, now: Optional[int]):
+    import time
+    import numpy as np
+    from cilium_tpu.utils.ip import addr_to_str, words_to_addr
+    arrays = engine.ct_arrays()
+    if now is None:
+        now = int(time.time())
+    live = np.nonzero(arrays["expiry"] > now)[0][:limit]
+    out = []
+    for slot in live:
+        w = arrays["keys"][slot]
+        out.append({
+            "src": addr_to_str(words_to_addr(w[0:4])),
+            "dst": addr_to_str(words_to_addr(w[4:8])),
+            "sport": int(w[8]) >> 16, "dport": int(w[8]) & 0xFFFF,
+            "proto": C.PROTO_NAMES.get(int(w[9]) >> 8, str(int(w[9]) >> 8)),
+            "expires_in": int(arrays["expiry"][slot]) - now,
+            "pkts_fwd": int(arrays["pkts_fwd"][slot]),
+            "pkts_rev": int(arrays["pkts_rev"][slot]),
+        })
+    return out
+
+
+def trace_doc(engine: "Engine", body: Dict) -> Tuple[int, Dict]:
+    from cilium_tpu.model.ipcache import lpm_lookup
+    ep = engine.endpoints.get(int(body.get("ep", -1)))
+    if ep is None:
+        return 404, {"error": f"endpoint {body.get('ep')} not found"}
+    direction = C.DIR_EGRESS if body.get("direction", "egress") == "egress" \
+        else C.DIR_INGRESS
+    proto = body.get("proto", C.PROTO_TCP)
+    if isinstance(proto, str):
+        names = {v.upper(): k for k, v in C.PROTO_NAMES.items()}
+        proto = int(proto) if proto.isdigit() else names.get(proto.upper())
+        if proto is None:
+            return 400, {"error": "unknown protocol"}
+    remote_id = lpm_lookup(engine.ctx.ipcache.snapshot(), body["remote"])
+    pol = engine.repo.resolve(ep)
+    dirpol = pol.direction(direction)
+    if not dirpol.enforced:
+        return 200, {"verdict": "ALLOWED", "remote_identity": remote_id,
+                     "reason": "direction not enforced (default mode)"}
+    res = dirpol.lookup(remote_id, proto, int(body["dport"]))
+    verdict = {C.VERDICT_DENY: ("DENIED", "explicit deny rule"),
+               C.VERDICT_MISS: ("DENIED", "no rule matched (default deny)"),
+               C.VERDICT_REDIRECT:
+                   ("ALLOWED", "L7 redirect (http rules apply per request)"),
+               C.VERDICT_ALLOW: ("ALLOWED", "allow rule matched")}
+    v, reason = verdict[res.decision]
+    doc = {"verdict": v, "reason": reason, "remote_identity": remote_id}
+    if res.key is not None:
+        doc["matched_key"] = {
+            "identity": res.key.identity, "proto": res.key.proto,
+            "port_lo": res.key.port_lo, "port_hi": res.key.port_hi}
+        doc["derived_from"] = list(res.entry.derived_from)
+    return 200, doc
+
+
+# --------------------------------------------------------------------------- #
+class _Handler(BaseHTTPRequestHandler):
+    engine: "Engine" = None        # injected per-server subclass
+    protocol_version = "HTTP/1.1"
+
+    # unix sockets have no client address; BaseHTTPRequestHandler expects one
+    def address_string(self):
+        return "unix"
+
+    def log_message(self, fmt, *args):   # quiet by default
+        pass
+
+    # -- plumbing -----------------------------------------------------------
+    def _send_json(self, code: int, doc) -> None:
+        body = json.dumps(doc, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict:
+        n = int(self.headers.get("Content-Length", 0))
+        if n == 0:
+            return {}
+        return json.loads(self.rfile.read(n))
+
+    def _route(self) -> Tuple[str, Dict[str, str]]:
+        path, _, query = self.path.partition("?")
+        params = {}
+        for part in query.split("&"):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                params[k] = v
+        return path.rstrip("/"), params
+
+    # -- methods ------------------------------------------------------------
+    def do_GET(self):          # noqa: N802 (http.server API)
+        eng = self.engine
+        path, q = self._route()
+        try:
+            if path == "/v1/healthz":
+                return self._send_json(200, {
+                    "status": "ok", "revision": eng.repo.revision})
+            if path == "/v1/status":
+                return self._send_json(200, status_doc(eng))
+            if path == "/v1/endpoints":
+                return self._send_json(200, endpoints_doc(eng))
+            if path.startswith("/v1/endpoints/"):
+                try:
+                    ep_id = int(path.rsplit("/", 1)[1])
+                except ValueError:
+                    return self._send_json(400, {"error": "bad endpoint id"})
+                doc = endpoint_doc(eng, ep_id)
+                if doc is None:
+                    return self._send_json(404, {"error": "not found"})
+                return self._send_json(200, doc)
+            if path == "/v1/identities":
+                return self._send_json(200, identities_doc(eng))
+            if path == "/v1/policy":
+                return self._send_json(200, [
+                    r.raw for r in eng.repo.all_rules() if r.raw is not None])
+            if path == "/v1/services":
+                return self._send_json(200, services_doc(eng))
+            if path == "/v1/fqdn/cache":
+                return self._send_json(200, fqdn_doc(eng))
+            if path == "/v1/ct":
+                return self._send_json(200, ct_doc(
+                    eng, int(q.get("limit", 64)),
+                    int(q["now"]) if "now" in q else None))
+            if path == "/v1/flows":
+                filters = {}
+                if "verdict" in q:
+                    filters["verdict"] = q["verdict"]
+                if "endpoint" in q:
+                    filters["endpoint_id"] = int(q["endpoint"])
+                return self._send_json(200, eng.flowlog.tail(
+                    int(q.get("last", 50)), **filters))
+            if path == "/v1/metrics":
+                return self._send_text(200, eng.metrics.render_prometheus())
+            if path == "/v1/config":
+                import dataclasses
+                return self._send_json(200, dataclasses.asdict(eng.config))
+            if path == "/v1/health":
+                return self._send_json(200, eng.health_probe())
+            return self._send_json(404, {"error": "no such route"})
+        except Exception as exc:   # route errors must not kill the server
+            return self._send_json(500, {"error": repr(exc)})
+
+    def do_POST(self):         # noqa: N802
+        eng = self.engine
+        path, _q = self._route()
+        try:
+            if path == "/v1/policy":
+                body = self._body()
+                rev = eng.apply_policy(body)
+                eng.regenerate()
+                return self._send_json(200, {"revision": rev})
+            if path == "/v1/policy/trace":
+                code, doc = trace_doc(eng, self._body())
+                return self._send_json(code, doc)
+            if path == "/v1/regenerate":
+                compiled = eng.regenerate(force=True)
+                return self._send_json(200, {"revision": compiled.revision})
+            return self._send_json(404, {"error": "no such route"})
+        except Exception as exc:
+            return self._send_json(500, {"error": repr(exc)})
+
+    def do_PATCH(self):        # noqa: N802
+        eng = self.engine
+        path, _q = self._route()
+        try:
+            if path == "/v1/config":
+                body = self._body()
+                mode = body.get("enforcement_mode")
+                if mode is not None:
+                    if mode not in C.ENFORCEMENT_MODES:
+                        return self._send_json(
+                            400, {"error": f"bad enforcement mode {mode!r}"})
+                    # the runtime-mutable subset (upstream: `cilium config
+                    # PolicyEnforcement=...`): change + recompile
+                    eng.ctx.enforcement_mode = mode
+                    eng.regenerate(force=True)
+                unknown = set(body) - {"enforcement_mode"}
+                if unknown:
+                    return self._send_json(
+                        400, {"error": f"not runtime-mutable: "
+                                       f"{sorted(unknown)}"})
+                return self._send_json(200, {"ok": True})
+            return self._send_json(404, {"error": "no such route"})
+        except Exception as exc:
+            return self._send_json(500, {"error": repr(exc)})
+
+
+# --------------------------------------------------------------------------- #
+# client (used by the CLI's --api/live mode; stdlib http.client over AF_UNIX)
+# --------------------------------------------------------------------------- #
+class UnixAPIClient:
+    def __init__(self, socket_path: str, timeout: float = 10.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+
+    def request(self, method: str, path: str, body=None):
+        import http.client
+        import socket
+
+        class _Conn(http.client.HTTPConnection):
+            def __init__(conn, sock_path, timeout):
+                super().__init__("localhost", timeout=timeout)
+                conn._sock_path = sock_path
+
+            def connect(conn):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(conn.timeout)
+                s.connect(conn._sock_path)
+                conn.sock = s
+
+        conn = _Conn(self.socket_path, self.timeout)
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        ctype = resp.headers.get("Content-Type", "")
+        if "json" in ctype:
+            return resp.status, json.loads(data)
+        return resp.status, data.decode()
+
+    def get(self, path: str):
+        return self.request("GET", path)
+
+    def post(self, path: str, body=None):
+        return self.request("POST", path, body)
+
+    def patch(self, path: str, body=None):
+        return self.request("PATCH", path, body)

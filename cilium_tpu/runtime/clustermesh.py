@@ -1,0 +1,178 @@
+"""Multi-host control-plane sync — the clustermesh analog (SURVEY.md §5
+distributed backend: "DCN/host network carries control-plane sync (policy
+snapshots to peer hosts, the etcd analog — keep it a simple gRPC/file
+protocol)"; upstream: ``pkg/clustermesh`` syncing ipcache/identities between
+clusters through etcd).
+
+Protocol: a shared store directory (NFS/object-store mount — the DCN-visible
+rendezvous; explicitly NOT a reimplementation of etcd, per SURVEY §5's
+non-goal). Each node atomically publishes ``<store>/<node>.json``:
+
+    {"node", "generation", "published_at",
+     "entries": {prefix: {"labels": [...]}}}
+
+carrying its local endpoints' IP prefixes with their LABEL SETS — labels,
+not numeric identities, cross the wire, exactly like upstream clustermesh:
+identity numbering is node-local, so the receiver allocates its own identity
+for each remote label set (same labels ⇒ same identity ⇒ remote pods are
+selectable by normal fromEndpoints/toEndpoints policy).
+
+Each node polls peers' files (a controller with backoff — the watch analog)
+and reconciles: new prefixes allocate+upsert, withdrawn prefixes release;
+a peer whose file goes stale (no heartbeat within ``stale_after_s``) is
+treated as failed and its state withdrawn (upstream: etcd lease expiry).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
+
+from cilium_tpu.model.labels import Labels
+
+if TYPE_CHECKING:
+    from cilium_tpu.runtime.engine import Engine
+
+log = logging.getLogger("cilium_tpu.clustermesh")
+
+FORMAT_VERSION = 1
+
+
+class ClusterMesh:
+    """Publishes this node's endpoint map and ingests peers' into the local
+    identity allocator + ipcache. Owned by the Engine; driven by the
+    ``clustermesh-sync`` controller."""
+
+    def __init__(self, engine: "Engine", store_dir: str, node_name: str,
+                 stale_after_s: float = 60.0):
+        if not node_name or "/" in node_name or node_name.startswith("."):
+            raise ValueError(f"bad node name {node_name!r}")
+        self.engine = engine
+        self.store_dir = store_dir
+        self.node_name = node_name
+        self.stale_after_s = stale_after_s
+        self._generation = 0
+        # peer → {prefix: (identity, labels_key)} we ingested (for release)
+        self._ingested: Dict[str, Dict[str, object]] = {}
+        os.makedirs(store_dir, exist_ok=True)
+
+    # -- publish ------------------------------------------------------------
+    def _own_entries(self) -> Dict[str, Dict]:
+        entries: Dict[str, Dict] = {}
+        for ep in self.engine.endpoints.values():
+            labels = list(ep.labels.to_strings())
+            for ip in ep.ips:
+                prefix = f"{ip}/128" if ":" in ip else f"{ip}/32"
+                entries[prefix] = {"labels": labels}
+        return entries
+
+    def publish(self) -> None:
+        """Write this node's state atomically (tmp + rename — readers never
+        see a torn file; the single-file-per-writer layout makes the store
+        safely multi-writer without locks)."""
+        self._generation += 1
+        doc = {
+            "format_version": FORMAT_VERSION,
+            "node": self.node_name,
+            "generation": self._generation,
+            "published_at": time.time(),
+            "entries": self._own_entries(),
+        }
+        fd, tmp = tempfile.mkstemp(dir=self.store_dir,
+                                   prefix=f".{self.node_name}-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(self.store_dir,
+                                     f"{self.node_name}.json"))
+
+    # -- ingest -------------------------------------------------------------
+    def _read_peers(self) -> Dict[str, Dict]:
+        peers: Dict[str, Dict] = {}
+        now = time.time()
+        for name in os.listdir(self.store_dir):
+            if not name.endswith(".json") or name.startswith("."):
+                continue
+            node = name[: -len(".json")]
+            if node == self.node_name:
+                continue
+            path = os.path.join(self.store_dir, name)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                log.warning("clustermesh: unreadable peer file %s: %s",
+                            name, e)
+                continue
+            if doc.get("format_version") != FORMAT_VERSION:
+                log.warning("clustermesh: peer %s speaks format %r, skipped",
+                            node, doc.get("format_version"))
+                continue
+            if now - doc.get("published_at", 0) > self.stale_after_s:
+                continue               # expired lease: treated as withdrawn
+            peers[node] = doc
+        return peers
+
+    def sync(self) -> Tuple[int, int]:
+        """One reconcile pass: ingest peers, withdraw the departed.
+        Returns (n_added, n_removed) ipcache entries."""
+        ctx = self.engine.ctx
+        peers = self._read_peers()
+        added = removed = 0
+        with self.engine._lock:            # noqa: SLF001 — same lifecycle
+            # withdrawals: peers gone/stale, or entries they dropped
+            for node in list(self._ingested):
+                peer_entries = (peers.get(node) or {}).get("entries", {})
+                held = self._ingested[node]
+                for prefix in list(held):
+                    new = peer_entries.get(prefix)
+                    old_ident, old_labels = held[prefix]
+                    if new is not None \
+                            and tuple(sorted(new["labels"])) == old_labels:
+                        continue
+                    # the prefix belongs to the departed peer pod: remove it
+                    # unconditionally (the identity may survive via other
+                    # refs — e.g. a local pod with the same labels — but a
+                    # stale IP mapping would grant the old pod's permissions
+                    # to whoever reuses the address)
+                    ctx.allocator.release(old_ident)
+                    ctx.ipcache.delete(prefix)
+                    del held[prefix]
+                    removed += 1
+                if not held:
+                    del self._ingested[node]
+            # additions/updates
+            for node, doc in peers.items():
+                held = self._ingested.setdefault(node, {})
+                for prefix, entry in doc.get("entries", {}).items():
+                    key = tuple(sorted(entry["labels"]))
+                    if prefix in held:
+                        continue       # unchanged (mismatches removed above)
+                    ident = ctx.allocator.allocate(Labels.parse(
+                        list(entry["labels"])))
+                    ctx.ipcache.upsert(prefix, ident.id)
+                    held[prefix] = (ident, key)
+                    added += 1
+        if added or removed:
+            self.engine.metrics.set_gauge(
+                "clustermesh_remote_entries",
+                sum(len(h) for h in self._ingested.values()))
+        self.engine.metrics.set_gauge("clustermesh_peers",
+                                      len(self._ingested))
+        return added, removed
+
+    def step(self) -> None:
+        """One controller tick: publish our state, ingest everyone else's."""
+        self.publish()
+        self.sync()
+
+    def withdraw(self) -> None:
+        """Remove this node's published state (clean shutdown)."""
+        try:
+            os.unlink(os.path.join(self.store_dir,
+                                   f"{self.node_name}.json"))
+        except OSError:
+            pass

@@ -42,6 +42,12 @@ class DaemonConfig:
     # repository changelog instead of full recompiles (geometry changes
     # still fall back to a full build — compile/incremental.py gates)
     incremental: bool = True
+    # --- api ---
+    api_socket: str = ""           # unix-socket REST path ("" = disabled)
+    # --- multi-host sync (clustermesh analog; runtime/clustermesh.py) ---
+    cluster_store: str = ""        # shared store dir ("" = single-host)
+    node_name: str = ""            # this node's name in the store
+    cluster_sync_interval_s: float = 5.0
     # --- observability ---
     flowlog_capacity: int = 16384
     flowlog_mode: str = "drops"    # all | drops | none

@@ -436,12 +436,15 @@ class FakeDatapath(DatapathBackend):
             overflow = len(items) - cap
             if overflow > 0:
                 # the oracle dict is unbounded; the array view is not —
-                # never lose flows silently
+                # never lose flows silently, and when forced to, drop the
+                # soonest-to-expire entries (deterministic, not
+                # insertion-order accident)
                 self.ct_export_truncated += overflow
                 logging.getLogger("cilium_tpu.datapath").warning(
                     "FakeDatapath.ct_arrays: %d CT entries exceed "
-                    "ct_capacity=%d and were dropped from the export",
-                    overflow, cap)
+                    "ct_capacity=%d; dropping the soonest-expiring from "
+                    "the export", overflow, cap)
+                items.sort(key=lambda kv: kv[1].expiry, reverse=True)
                 items = items[:cap]
         for slot, (key, e) in enumerate(items):
             src, dst, sport, dport, proto, d = key

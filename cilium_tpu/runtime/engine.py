@@ -88,6 +88,8 @@ class Engine:
         self._active: Optional[CompiledSnapshot] = None
         self._dirty = True
         self._inc = None           # IncrementalCompiler, seeded on full build
+        self._api = None           # APIServer when config.api_socket set
+        self._mesh = None          # ClusterMesh when cluster_store set
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -203,9 +205,11 @@ class Engine:
             snap = patch = None
             if (self._inc is not None and self._active is not None
                     and not force):
+                # NB: lb_cfg is deliberately not passed — LB geometry is
+                # fixed at daemon start; LB content changes gate via
+                # services_revision
                 with self.metrics.span("snapshot_patch").timer():
-                    result = self._inc.try_update(ct_cfg, lb_cfg,
-                                                  endpoints=eps)
+                    result = self._inc.try_update(ct_cfg, endpoints=eps)
                 if result is not None:
                     snap, patch, stats = result
                     self.metrics.inc_counter("regen_incremental_total")
@@ -215,26 +219,36 @@ class Engine:
                     logging.getLogger("cilium_tpu.engine").debug(
                         "incremental fallback: %s", self._inc.last_fallback)
 
-            if snap is None:
+            full_build = snap is None
+            if full_build:
                 with self.metrics.span("snapshot_compile").timer():
                     snap = build_snapshot(self.repo, self.ctx, eps,
                                           ct_cfg, lb_cfg)
                 self.metrics.inc_counter("regen_full_total")
-                if self.config.incremental:
-                    from cilium_tpu.compile.incremental import \
-                        IncrementalCompiler
-                    self._inc = IncrementalCompiler(self.repo, self.ctx,
-                                                    eps, snap)
 
-            with self.metrics.span("device_place").timer():
-                if patch is not None and self._active is not None:
-                    if patch.is_noop:
-                        tensors = self._active.tensors
+            try:
+                with self.metrics.span("device_place").timer():
+                    if patch is not None and self._active is not None:
+                        if patch.is_noop:
+                            tensors = self._active.tensors
+                        else:
+                            tensors = self.datapath.place_patch(
+                                self._active.tensors, snap, patch)
                     else:
-                        tensors = self.datapath.place_patch(
-                            self._active.tensors, snap, patch)
-                else:
-                    tensors = self.datapath.place(snap)
+                        tensors = self.datapath.place(snap)
+            except Exception:
+                # the incremental compiler already advanced past this
+                # revision; keeping it would let a retry pair the new
+                # snapshot with never-patched device tensors (silent stale
+                # policy). Discard — the retry takes the full-build path.
+                self._inc = None
+                raise
+            if full_build and self.config.incremental:
+                # seed only after placement succeeded (same staleness trap)
+                from cilium_tpu.compile.incremental import \
+                    IncrementalCompiler
+                self._inc = IncrementalCompiler(self.repo, self.ctx,
+                                                eps, snap)
             self.repo.prune_changes(snap.revision)
             compiled = CompiledSnapshot(
                 snapshot=snap, tensors=tensors,
@@ -279,7 +293,20 @@ class Engine:
         return reclaimed
 
     def start_background(self) -> None:
-        """Start the periodic controllers (sweep; more as they land)."""
+        """Start the periodic controllers and (when configured) the REST API
+        server on its unix socket (SURVEY.md §3.1 "api server up")."""
+        if self.config.api_socket and self._api is None:
+            from cilium_tpu.runtime.api import APIServer
+            self._api = APIServer(self, self.config.api_socket)
+            self._api.start()
+        if (self.config.cluster_store and self.config.node_name
+                and self._mesh is None):
+            from cilium_tpu.runtime.clustermesh import ClusterMesh
+            self._mesh = ClusterMesh(self, self.config.cluster_store,
+                                     self.config.node_name)
+            self.controllers.update(
+                "clustermesh-sync", self._mesh.step,
+                interval=self.config.cluster_sync_interval_s)
         self.controllers.update("ct-gc", lambda: self.sweep(),
                                 interval=self.config.sweep_interval_s)
         # expired DNS names must revoke their identities (upstream: fqdn
@@ -335,6 +362,21 @@ class Engine:
             sum(1 for r in report.values() if r["reachable"]))
         return report
 
+    def profile_classify(self, batch: Dict[str, np.ndarray], trace_dir: str,
+                         now: Optional[int] = None,
+                         repeats: int = 3) -> Dict[str, np.ndarray]:
+        """Run ``repeats`` classify steps under ``jax.profiler.trace`` →
+        an XProf/TensorBoard trace in ``trace_dir`` (SURVEY.md §5
+        tracing/profiling: the device half; host stage timers live in
+        metrics.span). Requires the JIT backend — with a fake datapath
+        there is no device program to profile."""
+        import jax
+        with jax.profiler.trace(trace_dir):
+            for i in range(repeats):
+                out = self.classify(dict(batch),
+                                    now=None if now is None else now + i)
+        return out
+
     def flush_observability(self) -> None:
         """Flush the flow-log sink and write the Prometheus text file (the
         hubble-export + node-exporter-textfile analog). Also callable
@@ -354,6 +396,12 @@ class Engine:
     def stop(self) -> None:
         self.controllers.stop_all()
         self._regen_trigger.cancel()
+        if self._api is not None:
+            self._api.stop()
+            self._api = None
+        if self._mesh is not None:
+            self._mesh.withdraw()
+            self._mesh = None
 
     # -- introspection ----------------------------------------------------------
     def ct_stats(self, now: Optional[int] = None) -> Dict[str, int]:

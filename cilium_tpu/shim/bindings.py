@@ -20,7 +20,10 @@ from cilium_tpu.kernels.records import empty_batch
 from cilium_tpu.utils import constants as C
 
 _SHIM_DIR = os.path.dirname(os.path.abspath(__file__))
-LIB_PATH = os.path.join(_SHIM_DIR, "libflowshim.so")
+# CILIUM_TPU_SHIM_LIB overrides the library (e.g. the TSan build from
+# `make -C cilium_tpu/shim tsan` — SURVEY §5 race detection)
+LIB_PATH = os.environ.get("CILIUM_TPU_SHIM_LIB",
+                          os.path.join(_SHIM_DIR, "libflowshim.so"))
 GOLDENGEN_PATH = os.path.join(_SHIM_DIR, "goldengen")
 
 
@@ -125,6 +128,11 @@ class FlowShim:
         self.batch_size = batch_size
         self._rec_buf = (ShimRecord * batch_size)()
         self._tok_buf = (ShimTokens * batch_size)()
+        # record counts of harvested-but-unverdicted batches, FIFO — the
+        # C++ side holds one FrameRef per emitted record, so apply_verdicts
+        # must consume exactly that many per batch (short verdict arrays
+        # would desync frames from verdicts; see apply_verdicts)
+        self._pending_counts: list = []
 
     def close(self):
         if self._handle:
@@ -140,6 +148,19 @@ class FlowShim:
         return self._lib.shim_feed_frame(
             self._handle, frame, len(frame), now_us) == 0
 
+    # structured-dtype mirrors of ShimRecord/ShimTokens for vectorized
+    # batch conversion (a per-record Python loop caps the harvest path at
+    # ~1e5 records/s; frombuffer keeps it out of the packet path)
+    _REC_DTYPE = np.dtype([
+        ("src", "<u4", (4,)), ("dst", "<u4", (4,)),
+        ("sport", "<u2"), ("dport", "<u2"),
+        ("proto", "u1"), ("tcp_flags", "u1"), ("is_v6", "u1"),
+        ("direction", "u1"), ("ep_id", "<u4"), ("frame_idx", "<u4"),
+        ("orig_len", "<u4"), ("pad", "u1", (12,))])
+    _TOK_DTYPE = np.dtype([
+        ("has_tokens", "u1"), ("method", "u1"), ("path_len", "<u2"),
+        ("path", "u1", (C.L7_PATH_MAXLEN,)), ("pad", "u1", (4,))])
+
     def poll_batch(self, now_us: int = 0, force: bool = False
                    ) -> Optional[Dict[str, np.ndarray]]:
         """Harvest a batch in the kernels/records layout (None if not ready).
@@ -148,32 +169,46 @@ class FlowShim:
                                       self._rec_buf, self._tok_buf)
         if n == 0:
             return None
+        self._pending_counts.append(int(n))
         b = empty_batch(self.batch_size)
         b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
         b["_frame_idx"] = np.zeros((self.batch_size,), dtype=np.int64)
-        for i in range(n):
-            r, t = self._rec_buf[i], self._tok_buf[i]
-            b["src"][i] = r.src[:]
-            b["dst"][i] = r.dst[:]
-            b["sport"][i] = r.sport
-            b["dport"][i] = r.dport
-            b["proto"][i] = r.proto
-            b["tcp_flags"][i] = r.tcp_flags
-            b["is_v6"][i] = bool(r.is_v6)
-            b["direction"][i] = r.direction
-            b["_ep_raw"][i] = r.ep_id
-            b["_frame_idx"][i] = r.frame_idx
-            if t.has_tokens:
-                b["http_method"][i] = t.method
-                b["http_path"][i, :t.path_len] = np.ctypeslib.as_array(
-                    t.path)[:t.path_len]
-            b["valid"][i] = r.ep_id != 0
+        rec = np.frombuffer(self._rec_buf, dtype=self._REC_DTYPE,
+                            count=self.batch_size)
+        tok = np.frombuffer(self._tok_buf, dtype=self._TOK_DTYPE,
+                            count=self.batch_size)
+        b["src"][:n] = rec["src"][:n]
+        b["dst"][:n] = rec["dst"][:n]
+        b["sport"][:n] = rec["sport"][:n]
+        b["dport"][:n] = rec["dport"][:n]
+        b["proto"][:n] = rec["proto"][:n]
+        b["tcp_flags"][:n] = rec["tcp_flags"][:n]
+        b["is_v6"][:n] = rec["is_v6"][:n].astype(bool)
+        b["direction"][:n] = rec["direction"][:n]
+        b["_ep_raw"][:n] = rec["ep_id"][:n]
+        b["_frame_idx"][:n] = rec["frame_idx"][:n]
+        b["valid"][:n] = rec["ep_id"][:n] != 0
+        has = tok["has_tokens"][:n].astype(bool)
+        b["http_method"][:n] = np.where(has, tok["method"][:n],
+                                        C.HTTP_METHOD_ANY)
+        pos = np.arange(C.L7_PATH_MAXLEN)
+        keep = has[:, None] & (pos[None, :] < tok["path_len"][:n, None])
+        b["http_path"][:n] = np.where(keep, tok["path"][:n], 0)
         return b
 
     def apply_verdicts(self, allow: np.ndarray) -> None:
-        arr = np.ascontiguousarray(allow.astype(np.uint8))
-        self._lib.shim_apply_verdicts(self._handle, arr.tobytes(),
-                                      arr.shape[0])
+        """Enforce verdicts for the OLDEST unverdicted batch. ``allow`` may
+        cover any prefix of that batch's records (e.g. only the valid rows);
+        the remainder is dropped (fail closed) — the C++ side holds one
+        frame per emitted record, so the full count must always be consumed
+        or later verdicts would enforce on the wrong frames."""
+        if not self._pending_counts:
+            raise RuntimeError("apply_verdicts without a harvested batch")
+        n = self._pending_counts.pop(0)
+        arr = np.zeros((n,), dtype=np.uint8)
+        k = min(n, int(np.asarray(allow).shape[0]))
+        arr[:k] = np.asarray(allow)[:k].astype(np.uint8)
+        self._lib.shim_apply_verdicts(self._handle, arr.tobytes(), n)
 
     def stats(self) -> Dict[str, int]:
         s = ShimStats()

@@ -1,0 +1,140 @@
+"""Multi-host control-plane sync (SURVEY.md §5 distributed backend; upstream
+pkg/clustermesh): two engines share a store directory; each publishes its
+endpoints' (prefix, labels) and ingests the other's, allocating LOCAL
+identities for remote label sets — so ordinary label policy selects remote
+pods, verdicts included."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from cilium_tpu.kernels.records import batch_from_records
+from cilium_tpu.runtime.clustermesh import ClusterMesh
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.datapath import FakeDatapath
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import PacketRecord
+
+
+def _node(tmp_path, name, node=True):
+    cfg = DaemonConfig(ct_capacity=1024, auto_regen=False,
+                       cluster_store=str(tmp_path / "store") if node else "",
+                       node_name=name if node else "")
+    return Engine(cfg, datapath=FakeDatapath(DaemonConfig(ct_capacity=1024)))
+
+
+def _pkt(src, dst, sp, dp, ep_id, d=C.DIR_INGRESS):
+    s16, _ = parse_addr(src)
+    d16, _ = parse_addr(dst)
+    return PacketRecord(s16, d16, sp, dp, C.PROTO_TCP, C.TCP_SYN, False,
+                        ep_id, d)
+
+
+class TestClusterMesh:
+    def test_cross_node_policy_by_labels(self, tmp_path):
+        """Node B's policy 'allow from role=backup' matches node A's pod via
+        the mesh: A publishes (ip, labels); B allocates a local identity for
+        those labels; B's selector picks it up; classify allows."""
+        a = _node(tmp_path, "node-a")
+        b = _node(tmp_path, "node-b")
+        a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.5",), ep_id=1)
+        b.add_endpoint(["k8s:app=db"], ips=("10.2.0.9",), ep_id=1)
+        b.apply_policy([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{"fromEndpoints": [
+                {"matchLabels": {"role": "backup"}}],
+                "toPorts": [{"ports": [
+                    {"port": "5432", "protocol": "TCP"}]}]}]}])
+
+        mesh_a = ClusterMesh(a, str(tmp_path / "store"), "node-a")
+        mesh_b = ClusterMesh(b, str(tmp_path / "store"), "node-b")
+        mesh_a.step()
+        mesh_b.step()
+        b.regenerate()
+
+        slots = b.active.snapshot.ep_slot_of
+        batch = batch_from_records(
+            [_pkt("10.1.0.5", "10.2.0.9", 40000, 5432, 1),   # remote backup
+             _pkt("10.9.9.9", "10.2.0.9", 40001, 5432, 1)],  # unknown world
+            slots)
+        out = b.classify(dict(batch), now=100)
+        assert bool(out["allow"][0]), "remote pod not selected by policy"
+        assert not bool(out["allow"][1])
+        # the remote identity resolved is a real local allocation with the
+        # peer's labels
+        rid = int(out["remote_identity"][0])
+        ident = b.ctx.allocator.get(rid)
+        assert ident is not None
+        assert "k8s:role=backup" in ident.labels.to_strings()
+
+    def test_withdrawal_and_stale_peer(self, tmp_path):
+        a = _node(tmp_path, "node-a")
+        b = _node(tmp_path, "node-b")
+        a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.5",), ep_id=1)
+        mesh_a = ClusterMesh(a, str(tmp_path / "store"), "node-a")
+        mesh_b = ClusterMesh(b, str(tmp_path / "store"), "node-b",
+                             stale_after_s=60)
+        mesh_a.step()
+        mesh_b.sync()
+        assert "10.1.0.5/32" in b.ctx.ipcache.snapshot()
+
+        # endpoint removed on A → withdrawn on B at the next round trip
+        a.remove_endpoint(1)
+        mesh_a.publish()
+        mesh_b.sync()
+        assert "10.1.0.5/32" not in b.ctx.ipcache.snapshot()
+
+        # stale peer file (lease expiry): state withdrawn even with no
+        # explicit removal
+        a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.6",), ep_id=2)
+        mesh_a.publish()
+        mesh_b.sync()
+        assert "10.1.0.6/32" in b.ctx.ipcache.snapshot()
+        path = tmp_path / "store" / "node-a.json"
+        doc = json.loads(path.read_text())
+        doc["published_at"] = time.time() - 3600
+        path.write_text(json.dumps(doc))
+        mesh_b.sync()
+        assert "10.1.0.6/32" not in b.ctx.ipcache.snapshot()
+
+    def test_label_change_reallocates(self, tmp_path):
+        a = _node(tmp_path, "node-a")
+        b = _node(tmp_path, "node-b")
+        a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.5",), ep_id=1)
+        mesh_a = ClusterMesh(a, str(tmp_path / "store"), "node-a")
+        mesh_b = ClusterMesh(b, str(tmp_path / "store"), "node-b")
+        mesh_a.step()
+        mesh_b.sync()
+        id1 = b.ctx.ipcache.snapshot()["10.1.0.5/32"]
+        # relabel the pod on A → B must re-ingest under a new identity
+        a.remove_endpoint(1)
+        a.add_endpoint(["k8s:role=primary"], ips=("10.1.0.5",), ep_id=2)
+        mesh_a.publish()
+        mesh_b.sync()
+        id2 = b.ctx.ipcache.snapshot()["10.1.0.5/32"]
+        assert id1 != id2
+        ident = b.ctx.allocator.get(id2)
+        assert "k8s:role=primary" in ident.labels.to_strings()
+
+    def test_engine_lifecycle_integration(self, tmp_path):
+        """start_background wires the controller; stop withdraws the node
+        file; corrupt peer files are skipped without failing the sync."""
+        a = _node(tmp_path, "node-a")
+        a.add_endpoint(["k8s:x=1"], ips=("10.1.0.7",), ep_id=1)
+        a.config.cluster_sync_interval_s = 0.05
+        a.start_background()
+        store = tmp_path / "store"
+        deadline = time.time() + 5
+        while not (store / "node-a.json").exists():
+            assert time.time() < deadline, "publish never happened"
+            time.sleep(0.02)
+        # garbage peer file must not break the loop
+        (store / "node-bad.json").write_text("{not json")
+        time.sleep(0.1)
+        assert (store / "node-a.json").exists()
+        a.stop()
+        assert not (store / "node-a.json").exists()

@@ -1,0 +1,256 @@
+"""Fuzzing of the two attacker-facing input paths (SURVEY.md §4 "keep: fuzz
+rule parser + header parser"; upstream fuzzes pkg/policy/api parsing and the
+datapath header parsers through oss-fuzz):
+
+- the CNP rule parser (model/rules.py): arbitrary JSON-shaped documents must
+  either parse into a well-formed Rule or raise RuleParseError — never any
+  other exception, never a Rule that then crashes resolution/compilation;
+- the C++ shim frame parser: arbitrary bytes and mutated valid frames must
+  never crash the process, and every accepted frame must carry sane field
+  ranges. Runs through ctypes against libflowshim.so, so a memory fault
+  would kill the test process — that IS the assertion.
+"""
+
+import os
+import random
+import struct
+import subprocess
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from cilium_tpu.model.labels import Labels
+from cilium_tpu.model.rules import RuleParseError, parse_rule
+from cilium_tpu.utils import constants as C
+
+SHIM_DIR = os.path.join(os.path.dirname(__file__), "..", "cilium_tpu", "shim")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_shim():
+    subprocess.run(["make", "-C", SHIM_DIR, "-s"], check=True)
+
+
+# --------------------------------------------------------------------------- #
+# rule parser: grammar-guided JSON documents
+# --------------------------------------------------------------------------- #
+_label_key = st.text(
+    alphabet=st.sampled_from("abcdefghij-._/"), min_size=0, max_size=12)
+_label_val = st.text(
+    alphabet=st.sampled_from("abcXYZ019-._"), min_size=0, max_size=12)
+_match_labels = st.dictionaries(_label_key, _label_val, max_size=3)
+_selector = st.fixed_dictionaries({}, optional={
+    "matchLabels": _match_labels,
+    "matchExpressions": st.lists(st.fixed_dictionaries({}, optional={
+        "key": _label_key,
+        "operator": st.sampled_from(
+            ["In", "NotIn", "Exists", "DoesNotExist", "Bogus"]),
+        "values": st.lists(_label_val, max_size=2),
+    }), max_size=2),
+})
+_port = st.one_of(
+    st.integers(min_value=-5, max_value=70000).map(str),
+    st.sampled_from(["", "http", "0", "65535", "65536", "1-2", "  80"]))
+_port_rule = st.fixed_dictionaries({}, optional={
+    "ports": st.lists(st.fixed_dictionaries({}, optional={
+        "port": _port,
+        "endPort": st.integers(min_value=-2, max_value=70000),
+        "protocol": st.sampled_from(
+            ["TCP", "UDP", "SCTP", "ANY", "tcp", "ICMP", "QUIC", ""]),
+    }), max_size=2),
+    "rules": st.fixed_dictionaries({}, optional={
+        "http": st.lists(st.fixed_dictionaries({}, optional={
+            "method": st.sampled_from(
+                ["GET", "POST", "get", "FETCH", ""]),
+            "path": st.text(alphabet=st.sampled_from("/abc%. *"),
+                            max_size=16),
+        }), max_size=2),
+    }),
+})
+_cidr = st.one_of(
+    st.sampled_from([
+        "10.0.0.0/8", "0.0.0.0/0", "::/0", "2001:db8::/32", "300.1.2.3/8",
+        "10.0.0.1/33", "10.0.0.1", "not-a-cidr", "", "10.0.0.0/-1",
+        "1.2.3.4/31", "fe80::1/128", "1.2.3.4/8",
+    ]),
+    st.tuples(st.integers(0, 255), st.integers(0, 255),
+              st.integers(0, 40)).map(lambda t: f"{t[0]}.{t[1]}.0.0/{t[2]}"))
+_block = st.fixed_dictionaries({}, optional={
+    "fromEndpoints": st.lists(_selector, max_size=2),
+    "toEndpoints": st.lists(_selector, max_size=2),
+    "fromEntities": st.lists(st.sampled_from(
+        ["all", "world", "host", "cluster", "remote-node", "nonsense"]),
+        max_size=2),
+    "toEntities": st.lists(st.sampled_from(["world", "host", "bad"]),
+                           max_size=2),
+    "toCIDR": st.lists(_cidr, max_size=2),
+    "toCIDRSet": st.lists(st.fixed_dictionaries({}, optional={
+        "cidr": _cidr, "except": st.lists(_cidr, max_size=2)}), max_size=2),
+    "toPorts": st.lists(_port_rule, max_size=2),
+    "icmps": st.lists(st.fixed_dictionaries({}, optional={
+        "fields": st.lists(st.fixed_dictionaries({}, optional={
+            "type": st.integers(-1, 300),
+            "family": st.sampled_from(["IPv4", "IPv6", "IPvX"]),
+        }), max_size=2)}), max_size=1),
+    "toServices": st.lists(st.fixed_dictionaries({}, optional={
+        "k8sService": st.fixed_dictionaries({}, optional={
+            "serviceName": _label_val, "namespace": _label_val})}),
+        max_size=1),
+    "toFQDNs": st.lists(st.fixed_dictionaries({}, optional={
+        "matchName": st.sampled_from(
+            ["example.com", "*.example.com", "", "..", "*"]),
+        "matchPattern": st.sampled_from(["*.svc.local", "**", ""]),
+    }), max_size=1),
+})
+_rule_doc = st.fixed_dictionaries(
+    {"endpointSelector": _selector},
+    optional={
+        "ingress": st.lists(_block, max_size=2),
+        "egress": st.lists(_block, max_size=2),
+        "ingressDeny": st.lists(_block, max_size=1),
+        "egressDeny": st.lists(_block, max_size=1),
+        "labels": st.lists(st.fixed_dictionaries({}, optional={
+            "key": _label_key, "value": _label_val,
+            "source": st.sampled_from(["k8s", "unspec"])}), max_size=2),
+        "description": st.text(max_size=20),
+        "unknownField": st.integers(),
+    })
+
+
+class TestRuleParserFuzz:
+    @settings(max_examples=400, deadline=None)
+    @given(doc=_rule_doc)
+    def test_parse_rule_total(self, doc):
+        """parse_rule is total over JSON documents: a Rule or RuleParseError,
+        nothing else; accepted rules survive selection + contribution
+        expansion against a live repository (the path a hostile CNP would
+        take to the compiler)."""
+        try:
+            rule = parse_rule(doc)
+        except RuleParseError:
+            return
+        # accepted → must be usable end to end
+        from cilium_tpu.model.endpoint import Endpoint
+        from cilium_tpu.model.identity import IdentityAllocator
+        from cilium_tpu.model.ipcache import IPCache
+        from cilium_tpu.policy import PolicyContext, Repository
+        from cilium_tpu.policy.selectorcache import SelectorCache
+        alloc = IdentityAllocator()
+        ctx = PolicyContext(allocator=alloc,
+                            selector_cache=SelectorCache(alloc),
+                            ipcache=IPCache())
+        repo = Repository(ctx)
+        repo.add([rule])
+        lbls = Labels.parse(["k8s:a=b"])
+        ident = alloc.allocate(lbls)
+        ep = Endpoint(ep_id=1, labels=lbls, identity_id=ident.id)
+        pol = repo.resolve(ep)
+        # every compiled key is range-sane
+        for dirpol in (pol.ingress, pol.egress):
+            for key, entry in dirpol.mapstate.items():
+                assert 0 <= key.port_lo <= key.port_hi <= 65535
+                assert 0 <= key.proto <= 255
+        repo.clear()
+
+    @settings(max_examples=100, deadline=None)
+    @given(data=st.recursive(
+        st.one_of(st.none(), st.booleans(), st.integers(), st.text(max_size=8)),
+        lambda children: st.one_of(
+            st.lists(children, max_size=3),
+            st.dictionaries(st.text(max_size=8), children, max_size=3)),
+        max_leaves=12))
+    def test_parse_rule_arbitrary_json(self, data):
+        """Entirely unstructured JSON values must raise RuleParseError (or
+        parse, for the rare shape-coincident doc) — never TypeError/KeyError."""
+        try:
+            parse_rule(data)
+        except RuleParseError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# shim frame parser: garbage + mutation corpus through the C ABI
+# --------------------------------------------------------------------------- #
+def _mutate(frame: bytes, rng: random.Random) -> bytes:
+    b = bytearray(frame)
+    op = rng.randrange(4)
+    if op == 0 and len(b) > 1:           # truncate
+        del b[rng.randrange(1, len(b)):]
+    elif op == 1:                        # flip random bytes
+        for _ in range(rng.randrange(1, 8)):
+            b[rng.randrange(len(b))] = rng.randrange(256)
+    elif op == 2:                        # extend with junk
+        b += bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+    else:                                # corrupt length/header fields
+        for off in (14 + 2, 14 + 3, 14 + 0, 12, 13):
+            if off < len(b):
+                b[off] = rng.randrange(256)
+    return bytes(b)
+
+
+class TestShimFrameFuzz:
+    def test_garbage_and_mutated_frames(self):
+        from cilium_tpu.shim.bindings import (
+            FlowShim, build_frame, build_http_frame)
+        rng = random.Random(0xC0FFEE)
+        s = FlowShim(batch_size=64, timeout_us=0)
+        s.register_endpoint("192.168.1.10", 1)
+        seeds = [
+            build_frame("192.168.1.10", "10.0.0.1", 40000, 443),
+            build_frame("192.168.1.10", "10.0.0.1", 1, 1,
+                        proto=C.PROTO_UDP),
+            build_frame("2001:db8::10", "2001:db8::1", 2, 2),
+            build_frame("192.168.1.10", "10.0.0.1", 3, 8,
+                        proto=C.PROTO_ICMP),
+            build_frame("192.168.1.10", "10.0.0.1", 4, 443, vlan=7),
+            build_http_frame("9.9.9.9", "192.168.1.10", 5, 80,
+                             "GET", "/" + "a" * 100),
+        ]
+        n_fed = 0
+        for trial in range(3000):
+            if trial % 5 == 0:
+                frame = bytes(rng.randrange(256)
+                              for _ in range(rng.randrange(0, 160)))
+            else:
+                frame = _mutate(rng.choice(seeds), rng)
+            s.feed_frame(frame)        # must not crash, any return ok
+            n_fed += 1
+            if n_fed % 64 == 0:
+                b = s.poll_batch(force=True)
+                if b is None:
+                    continue
+                # accepted records carry sane ranges
+                valid = b["_ep_raw"] != 0
+                assert (b["sport"][:64] >= 0).all()
+                assert (b["sport"][:64] <= 65535).all()
+                assert (b["dport"][:64] >= 0).all()
+                assert (b["dport"][:64] <= 65535).all()
+                assert (b["proto"][:64] >= 0).all()
+                assert (b["proto"][:64] <= 255).all()
+        st_ = s.stats()
+        assert st_["frames_seen"] == 3000
+        assert st_["frames_parsed"] + st_["parse_errors"] == 3000
+        s.close()
+
+    def test_http_tokenizer_hostile_payloads(self):
+        from cilium_tpu.shim.bindings import FlowShim, build_frame
+        s = FlowShim(batch_size=16, timeout_us=0)
+        s.register_endpoint("192.168.1.10", 1)
+        hostile = [
+            b"GET ",                      # method, no path
+            b"GET  HTTP/1.1\r\n",         # empty path
+            b"GET /" + b"x" * 500,        # path far over 64B
+            b"G",                         # truncated method
+            b"GET\t/p HTTP/1.1",          # tab separator (not a space)
+            b"POST " + b"\xff" * 70,      # binary path
+            b"OPTIONS * HTTP/1.1\r\n",
+            b"\r\n\r\nGET /late HTTP/1.1",
+        ]
+        for i, payload in enumerate(hostile):
+            s.feed_frame(build_frame("9.9.9.9", "192.168.1.10", 100 + i, 80,
+                                     tcp_flags=C.TCP_ACK, payload=payload))
+        b = s.poll_batch(force=True)
+        assert b is not None
+        # tokenized paths are always NUL-padded 64B, length-capped
+        assert b["http_path"].shape[1] == C.L7_PATH_MAXLEN
+        s.close()

@@ -385,3 +385,41 @@ class TestRegenFailureVisibility:
         assert eng.metrics.counters.get("regen_failures_total") == 1
         assert any("regeneration failed" in r.message for r in caplog.records)
         assert "regen_failures_total 1" in eng.metrics.render_prometheus()
+
+
+class TestDebugChecksHarness:
+    def test_classify_under_debug_nans_and_checks(self):
+        """SURVEY §5 race-detection/sanitizer row: the datapath program must
+        be clean under jax_debug_nans + checking config (the eBPF-verifier
+        -strictness analog for numerics) — NaN-producing ops or invalid
+        indexing in the fused kernel would raise here."""
+        import jax
+        from cilium_tpu.kernels.records import batch_from_records
+        from cilium_tpu.runtime.config import DaemonConfig
+        from cilium_tpu.runtime.datapath import JITDatapath
+        from cilium_tpu.runtime.engine import Engine
+        from cilium_tpu.utils.ip import parse_addr
+        from oracle import PacketRecord
+
+        jax.config.update("jax_debug_nans", True)
+        try:
+            eng = Engine(DaemonConfig(ct_capacity=1024, auto_regen=False),
+                         datapath=JITDatapath(DaemonConfig(
+                             ct_capacity=1024, auto_regen=False)))
+            eng.add_endpoint(["k8s:app=web"], ips=("192.168.5.10",), ep_id=1)
+            eng.apply_policy([{
+                "endpointSelector": {"matchLabels": {"app": "web"}},
+                "egress": [{"toCIDR": ["10.0.0.0/8"],
+                            "toPorts": [{"ports": [
+                                {"port": "443", "protocol": "TCP"}]}]}]}])
+            eng.regenerate()
+            s16, _ = parse_addr("192.168.5.10")
+            d16, _ = parse_addr("10.3.2.1")
+            pkts = [PacketRecord(s16, d16, 40000 + i, 443, C.PROTO_TCP,
+                                 C.TCP_SYN, False, 1, C.DIR_EGRESS)
+                    for i in range(32)]
+            out = eng.classify(batch_from_records(
+                pkts, eng.active.snapshot.ep_slot_of), now=100)
+            assert bool(out["allow"][0])
+        finally:
+            jax.config.update("jax_debug_nans", False)
