@@ -414,12 +414,19 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     in the headline timing.
 
     Statistics (round-4 verdict item 3: the harness must detect its own
-    noise): ``windows`` (>=5) timing windows run per mode and the MEDIAN is
-    reported with the IQR alongside — never best-of. Two modes are measured:
-    - transfer-included (the headline, what an AF_XDP pipeline sees), and
-    - compute-only (batches pre-resident on device) — separating host↔TPU
-      tunnel jitter from kernel regressions: if transfer medians move but
-      compute medians don't, the link moved, not the code.
+    noise): ``windows`` (>=5) timing windows run per mode, each calibrated
+    to span >=~0.3s (short windows measure dispatch granularity — the
+    kernel clears 65k records in ~100us), and the MEDIAN is reported with
+    the IQR alongside — never best-of. Three numbers are measured:
+    - ``value``: sustained transfer-included median (what a long-running
+      AF_XDP pipeline sees). On this rig the host↔TPU tunnel is a token
+      bucket — fast bursts, then a ~100-150MB/s sustained floor — so for
+      configs run after the bucket drains this measures the LINK;
+    - ``burst``: the bucket-fresh transfer rate (first pass);
+    - ``compute_only``: device-resident batches — the framework's own
+      throughput, reproducible run-to-run within a few percent. If
+      ``value`` moves between runs but ``compute_only`` doesn't, the link
+      moved, not the code.
 
     ``shards``/``rule_shards`` > 1 route the run through the production mesh
     path (parallel/mesh.make_sharded_classify_fn over a ('flows','rules')
@@ -528,17 +535,39 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
                     jnp.uint32(now), wi)
             jax.block_until_ready(out)
         print(f"# profiler trace written to {profile_dir}", file=sys.stderr)
-    xfer_tp = []
-    for _w in range(windows):
+
+    def _xfer_pass():
+        nonlocal now, ct, out, counters
         nxt = jax.device_put(host_batches[0])
-        t1 = time.time()
         for i in range(batches):
             cur = nxt
             nxt = jax.device_put(host_batches[(i + 1) % len(host_batches)])
             now += 1
             out, ct, counters = fn(tensors, ct, cur, jnp.uint32(now), wi)
         jax.block_until_ready(out)
-        xfer_tp.append(batches * eff_batch / (time.time() - t1))
+
+    # calibration: the fused kernel clears 65k records in ~100us, so a
+    # fixed-batch window can be milliseconds — measuring dispatch
+    # granularity and single jitter bursts, not steady state (the round-4
+    # "2.9x swing on identical code" failure). Repeat each window's pass
+    # until it spans >= ~0.3s.
+    t1 = time.time()
+    _xfer_pass()
+    first_pass_s = max(time.time() - t1, 1e-4)
+    # the calibration pass doubles as the BURST rate probe: this rig's
+    # host↔TPU tunnel has a token-bucket shape (fast bursts, then a
+    # ~100-150MB/s sustained floor), so a short window measures the bucket
+    # state, not the framework. `value` reports the sustained median;
+    # `burst` the bucket-fresh rate. Compute-only separates the kernels
+    # from the link entirely.
+    burst_tp = batches * eff_batch / first_pass_s
+    xfer_reps = max(1, min(50, int(0.3 / first_pass_s)))
+    xfer_tp = []
+    for _w in range(windows):
+        t1 = time.time()
+        for _r in range(xfer_reps):
+            _xfer_pass()
+        xfer_tp.append(xfer_reps * batches * eff_batch / (time.time() - t1))
 
     # -- mode 2: compute-only (device-resident batches) --------------------- #
     if sharded:
@@ -550,16 +579,25 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     else:
         dev_batches = [jax.device_put(hb) for hb in host_batches[:4]]
     jax.block_until_ready(dev_batches)
-    comp_tp = []
-    for _w in range(windows):
-        t1 = time.time()
+
+    def _comp_pass():
+        nonlocal now, ct, out, counters
         for i in range(batches):
             now += 1
             out, ct, counters = fn(tensors, ct,
                                    dev_batches[i % len(dev_batches)],
                                    jnp.uint32(now), wi)
         jax.block_until_ready(out)
-        comp_tp.append(batches * eff_batch / (time.time() - t1))
+
+    t1 = time.time()
+    _comp_pass()
+    comp_reps = max(1, min(200, int(0.3 / max(time.time() - t1, 1e-4))))
+    comp_tp = []
+    for _w in range(windows):
+        t1 = time.time()
+        for _r in range(comp_reps):
+            _comp_pass()
+        comp_tp.append(comp_reps * batches * eff_batch / (time.time() - t1))
 
     def _stats(vals):
         v = np.asarray(vals, dtype=np.float64)
@@ -605,6 +643,7 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
         "unit": "flows/sec/chip",
         "vs_baseline": round(xfer_med / n_chips / PER_CHIP_TARGET, 4),
         "iqr": round(xfer_iqr / n_chips, 1),
+        "burst": round(burst_tp / n_chips, 1),
         "compute_only": round(comp_med / n_chips, 1),
         "compute_only_iqr": round(comp_iqr / n_chips, 1),
         "windows": windows,

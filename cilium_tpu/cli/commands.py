@@ -491,17 +491,34 @@ def _cmd_monitor(args) -> int:
     if args.api:
         from cilium_tpu.runtime.api import UnixAPIClient
         client = UnixAPIClient(args.api)
-        path = f"/v1/flows?last={args.last}"
+        qualifiers = ""
         if args.verdict:
-            path += f"&verdict={args.verdict}"
+            qualifiers += f"&verdict={args.verdict}"
         if args.endpoint is not None:
-            path += f"&endpoint={args.endpoint}"
-        status, records = client.get(path)
+            qualifiers += f"&endpoint={args.endpoint}"
+        status, records = client.get(f"/v1/flows?last={args.last}"
+                                     + qualifiers)
         if status != 200:
             print(f"API error {status}: {records}", file=sys.stderr)
             return 1
         emit([r for r in records if _flow_matches(r, args)])
-        return 0
+        if not args.follow:
+            return 0
+        # live follow: poll the seq cursor (hubble observe --follow analog)
+        cursor = max((r.get("seq", 0) for r in records), default=0)
+        try:
+            while True:
+                _time.sleep(0.3)
+                status, fresh = client.get(
+                    f"/v1/flows?since={cursor}" + qualifiers)
+                if status != 200:
+                    print(f"API error {status}: {fresh}", file=sys.stderr)
+                    return 1
+                if fresh:
+                    cursor = max(r.get("seq", 0) for r in fresh)
+                    emit([r for r in fresh if _flow_matches(r, args)])
+        except KeyboardInterrupt:
+            return 0
     if not args.flowlog_path:
         print("one of --flowlog-path or --api is required", file=sys.stderr)
         return 1

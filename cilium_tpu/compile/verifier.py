@@ -69,9 +69,16 @@ def _build_world(l7: bool, lb: bool, v6: bool):
                          "rules": {"http": [
                              {"method": "GET", "path": "/api"}]}}]}]})
     if lb:
+        # a REAL frontend: the snapshot only carries LB tensors (and the
+        # kernel only compiles the LB stage — frontend probe, Maglev,
+        # rev-NAT) when one exists; a frontend-less service would make
+        # every "+lb" combo compile the identical LB-free program
+        from cilium_tpu.model.services import Backend, Frontend
+        from cilium_tpu.utils import constants as CC
         ctx.services.upsert(Service(
             name="api", namespace="prod", backends=("10.3.0.1",),
-            frontends=()))
+            frontends=(Frontend("10.96.0.10", 443, CC.PROTO_TCP),),
+            lb_backends=(Backend("10.3.0.1", 8443),)))
         docs.append({"endpointSelector": {"matchLabels": {"app": "web"}},
                      "egress": [{"toServices": [{"k8sService": {
                          "serviceName": "api", "namespace": "prod"}}]}]})

@@ -216,9 +216,11 @@ PACK4_L7_WORDS = PACK4_WORDS + 1
 PACK_L7DICT_WORDS = PACK_WORDS + 1
 
 
-def _pack_path_dict(paths: np.ndarray, path_words: Optional[int]
-                    ) -> Tuple[np.ndarray, np.ndarray]:
-    """[N, 64] uint8 → (dict_words [U_pow2, P] uint32, index [N] int64)."""
+def _pack_path_dict(paths: np.ndarray, path_words: Optional[int],
+                    min_rows: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """[N, 64] uint8 → (dict_words [U_pow2, P] uint32, index [N] int64).
+    ``min_rows`` floors the padded row count (callers pin it grow-only so
+    serving doesn't retrace when per-batch path diversity fluctuates)."""
     uniq, idx = np.unique(paths, axis=0, return_inverse=True)
     if uniq.shape[0] > 65536:
         raise ValueError("path dictionary overflow (>64k unique paths)")
@@ -227,7 +229,7 @@ def _pack_path_dict(paths: np.ndarray, path_words: Optional[int]
     path_words = min(path_words, C.L7_PATH_MAXLEN // 4)
     if uniq[:, 4 * path_words:].any():
         raise ValueError(f"path_words={path_words} truncates a path")
-    u_pad = 1 << max(0, (uniq.shape[0] - 1)).bit_length()
+    u_pad = 1 << max(0, (max(uniq.shape[0], min_rows) - 1)).bit_length()
     p = np.zeros((u_pad, 4 * path_words), dtype=np.uint32)
     p[:uniq.shape[0]] = uniq[:, :4 * path_words]
     p = p.reshape(u_pad, path_words, 4)
@@ -236,13 +238,17 @@ def _pack_path_dict(paths: np.ndarray, path_words: Optional[int]
     return words, idx
 
 
-def pack_batch_l7dict(b: BatchArrays, path_words: Optional[int] = None
+def pack_batch_l7dict(b: BatchArrays, path_words: Optional[int] = None,
+                      min_rows: int = 1, force_full: bool = False
                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Pack an L7 batch as (wire, path_dict). Picks the 5-word v4-compact
-    wire when the batch qualifies, else the 12-word full wire."""
-    dict_words, idx = _pack_path_dict(b["http_path"], path_words)
+    wire when the batch qualifies, else the 12-word full wire
+    (``force_full`` pins the full wire so serving paths don't flap formats
+    batch-to-batch)."""
+    dict_words, idx = _pack_path_dict(b["http_path"], path_words, min_rows)
     n = b["valid"].shape[0]
-    if not b["is_v6"].any() and not (b["ep_slot"] > PACK4_EP_SLOT_MAX).any():
+    if not force_full and not b["is_v6"].any() \
+            and not (b["ep_slot"] > PACK4_EP_SLOT_MAX).any():
         wire = np.empty((n, PACK4_L7_WORDS), dtype=np.uint32)
         wire[:, 0] = b["src"][:, 3]
         wire[:, 1] = b["dst"][:, 3]

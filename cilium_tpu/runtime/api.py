@@ -177,6 +177,9 @@ def ct_doc(engine: "Engine", limit: int, now: Optional[int]):
 
 def trace_doc(engine: "Engine", body: Dict) -> Tuple[int, Dict]:
     from cilium_tpu.model.ipcache import lpm_lookup
+    missing = [k for k in ("ep", "remote", "dport") if k not in body]
+    if missing:
+        return 400, {"error": f"trace requires {missing}"}
     ep = engine.endpoints.get(int(body.get("ep", -1)))
     if ep is None:
         return 404, {"error": f"endpoint {body.get('ep')} not found"}
@@ -294,6 +297,9 @@ class _Handler(BaseHTTPRequestHandler):
                     filters["verdict"] = q["verdict"]
                 if "endpoint" in q:
                     filters["endpoint_id"] = int(q["endpoint"])
+                if "since" in q:      # live-follow cursor (seq-based)
+                    return self._send_json(200, eng.flowlog.since(
+                        int(q["since"]), **filters))
                 return self._send_json(200, eng.flowlog.tail(
                     int(q.get("last", 50)), **filters))
             if path == "/v1/metrics":
@@ -332,6 +338,14 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/v1/config":
                 body = self._body()
+                # validate the WHOLE request before mutating anything — a
+                # 400 must mean "nothing changed" (enforcement mode is
+                # security-critical state)
+                unknown = set(body) - {"enforcement_mode"}
+                if unknown:
+                    return self._send_json(
+                        400, {"error": f"not runtime-mutable: "
+                                       f"{sorted(unknown)}"})
                 mode = body.get("enforcement_mode")
                 if mode is not None:
                     if mode not in C.ENFORCEMENT_MODES:
@@ -341,11 +355,6 @@ class _Handler(BaseHTTPRequestHandler):
                     # PolicyEnforcement=...`): change + recompile
                     eng.ctx.enforcement_mode = mode
                     eng.regenerate(force=True)
-                unknown = set(body) - {"enforcement_mode"}
-                if unknown:
-                    return self._send_json(
-                        400, {"error": f"not runtime-mutable: "
-                                       f"{sorted(unknown)}"})
                 return self._send_json(200, {"ok": True})
             return self._send_json(404, {"error": "no such route"})
         except Exception as exc:

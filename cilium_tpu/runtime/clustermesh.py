@@ -58,6 +58,10 @@ class ClusterMesh:
         self._generation = 0
         # peer → {prefix: (identity, labels_key)} we ingested (for release)
         self._ingested: Dict[str, Dict[str, object]] = {}
+        # peer → (doc, last_good_read_ts): a transiently unreadable file
+        # (NFS hiccup) must NOT read as departure — the lease
+        # (stale_after_s), not one failed read, decides withdrawal
+        self._last_good: Dict[str, Tuple[Dict, float]] = {}
         os.makedirs(store_dir, exist_ok=True)
 
     # -- publish ------------------------------------------------------------
@@ -93,25 +97,44 @@ class ClusterMesh:
     def _read_peers(self) -> Dict[str, Dict]:
         peers: Dict[str, Dict] = {}
         now = time.time()
-        for name in os.listdir(self.store_dir):
+        listing_ok = True
+        try:
+            names = os.listdir(self.store_dir)
+        except OSError as e:           # whole store unreachable: hold state
+            log.warning("clustermesh: store unreadable (%s); holding "
+                        "last-known peer state", e)
+            names = []
+            listing_ok = False
+        seen = set()
+        for name in names:
             if not name.endswith(".json") or name.startswith("."):
                 continue
             node = name[: -len(".json")]
             if node == self.node_name:
                 continue
+            seen.add(node)
             path = os.path.join(self.store_dir, name)
             try:
                 with open(path) as f:
                     doc = json.load(f)
             except (OSError, json.JSONDecodeError) as e:
-                log.warning("clustermesh: unreadable peer file %s: %s",
-                            name, e)
-                continue
-            if doc.get("format_version") != FORMAT_VERSION:
-                log.warning("clustermesh: peer %s speaks format %r, skipped",
-                            node, doc.get("format_version"))
+                log.warning("clustermesh: unreadable peer file %s: %s "
+                            "(holding last-known state)", name, e)
+                doc = None
+            if doc is not None:
+                if doc.get("format_version") != FORMAT_VERSION:
+                    log.warning("clustermesh: peer %s speaks format %r, "
+                                "skipped", node, doc.get("format_version"))
+                    continue
+                self._last_good[node] = (doc, now)
+        for node, (doc, _ts) in list(self._last_good.items()):
+            if listing_ok and node not in seen:
+                # file explicitly gone from a healthy store: the peer's
+                # clean withdraw() — immediate removal (etcd delete analog)
+                del self._last_good[node]
                 continue
             if now - doc.get("published_at", 0) > self.stale_after_s:
+                del self._last_good[node]
                 continue               # expired lease: treated as withdrawn
             peers[node] = doc
         return peers

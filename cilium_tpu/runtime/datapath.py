@@ -182,6 +182,14 @@ class JITDatapath(DatapathBackend):
         # donated CT buffers make concurrent classify a use-after-donate;
         # serialize the device step (host-side controllers may call in)
         self._ct_lock = threading.Lock()
+        # wire-format stickiness: each (format, shape) is a separate XLA
+        # trace (seconds), so per-batch content must not flap the choice —
+        # once L7/v6 traffic is seen the wider format stays, and L7 dict
+        # geometry (path words, dict rows) only grows
+        self._wire_l7 = False
+        self._wire_wide = False        # v6 or >14-bit ep_slot seen
+        self._l7_path_words = 1
+        self._l7_dict_rows = 1
 
     def place(self, snap: PolicySnapshot) -> Dict:
         jnp = self._jnp
@@ -230,15 +238,23 @@ class JITDatapath(DatapathBackend):
         if self._sharded:
             return self._classify_sharded(placed, snap, batch, now)
         from cilium_tpu.kernels.records import (
-            PACK4_EP_SLOT_MAX, pack_batch, pack_batch_l7dict, pack_batch_v4)
+            PACK4_EP_SLOT_MAX, _path_words_of, pack_batch, pack_batch_l7dict,
+            pack_batch_v4)
         b = {k: np.asarray(v) for k, v in batch.items()}
-        has_l7 = bool((b["http_method"] != C.HTTP_METHOD_ANY).any()
-                      or b["http_path"].any())
-        if has_l7:
-            wire, path_dict = pack_batch_l7dict(b)
+        self._wire_l7 |= bool((b["http_method"] != C.HTTP_METHOD_ANY).any()
+                              or b["http_path"].any())
+        self._wire_wide |= bool(
+            b["is_v6"].any()
+            or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
+        if self._wire_l7:
+            self._l7_path_words = max(self._l7_path_words,
+                                      _path_words_of(b["http_path"]))
+            wire, path_dict = pack_batch_l7dict(
+                b, path_words=self._l7_path_words,
+                min_rows=self._l7_dict_rows, force_full=self._wire_wide)
+            self._l7_dict_rows = max(self._l7_dict_rows, path_dict.shape[0])
             dev_batch = (jnp.asarray(wire), jnp.asarray(path_dict))
-        elif (not b["is_v6"].any()
-                and int(b["ep_slot"].max(initial=0)) <= PACK4_EP_SLOT_MAX):
+        elif not self._wire_wide:
             dev_batch = jnp.asarray(pack_batch_v4(b))
         else:
             dev_batch = jnp.asarray(pack_batch(b))

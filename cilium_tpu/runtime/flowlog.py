@@ -30,6 +30,7 @@ class FlowLog:
         self._lock = threading.Lock()
         self._ring: List[Dict] = []
         self._next = 0
+        self._seq = 0                  # monotonic record id (live follow)
         self._sink_buf: List[str] = []
         self.sink_dropped = 0          # lines shed when _sink_buf hit its cap
         self.total_seen = 0
@@ -75,6 +76,8 @@ class FlowLog:
             })
         with self._lock:
             for rec in records:
+                self._seq += 1
+                rec["seq"] = self._seq
                 if len(self._ring) < self.capacity:
                     self._ring.append(rec)
                 else:
@@ -123,6 +126,18 @@ class FlowLog:
             items = [r for r in items
                      if all(r.get(k) == v for k, v in filters.items())]
         return items[-n:]
+
+    def since(self, seq: int, limit: int = 1000, **filters) -> List[Dict]:
+        """Records with seq > ``seq``, oldest first (live-follow cursor; the
+        API's /v1/flows?since= and `monitor --api -f` poll this)."""
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                items = self._ring[:]
+            else:
+                items = self._ring[self._next:] + self._ring[:self._next]
+        out = [r for r in items if r.get("seq", 0) > seq
+               and all(r.get(k) == v for k, v in filters.items())]
+        return out[:limit]
 
     def to_jsonl(self, n: int = 100) -> str:
         return "\n".join(json.dumps(r) for r in self.tail(n))

@@ -60,7 +60,13 @@ class ShimStats(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "frames_seen", "frames_parsed", "parse_errors", "batches_emitted",
         "records_emitted", "verdict_drops", "verdict_passes",
-        "tx_full_drops")]
+        "tx_full_drops", "verdict_expired")]
+
+
+# must equal flowshim.cc kMaxUnverdictedBatches: both sides age out the
+# oldest unverdicted batch at the same poll, keeping the verdict FIFO and
+# the Python count FIFO aligned
+MAX_UNVERDICTED_BATCHES = 64
 
 
 def _load_lib():
@@ -170,6 +176,8 @@ class FlowShim:
         if n == 0:
             return None
         self._pending_counts.append(int(n))
+        if len(self._pending_counts) > MAX_UNVERDICTED_BATCHES:
+            self._pending_counts.pop(0)   # C++ aged out the same batch
         b = empty_batch(self.batch_size)
         b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
         b["_frame_idx"] = np.zeros((self.batch_size,), dtype=np.int64)

@@ -159,10 +159,13 @@ struct Shim {
   ShimStats stats{};
   uint32_t next_frame_idx = 0;
   // frames of emitted-but-unverdicted batches, in emission order —
-  // shim_apply_verdicts consumes from the front (FIFO matches the
+  // shim_apply_verdicts consumes the oldest batch (FIFO matches the
   // poll_batch → classify → verdict pipeline, including when several
-  // batches are in flight)
-  std::deque<FrameRef> emitted;
+  // batches are in flight). Bounded: harvest-only consumers (tap mode,
+  // pcap replay) never apply verdicts, so old batches age out (frames
+  // recycled, counted in verdict_expired). The Python binding mirrors
+  // kMaxUnverdictedBatches for its per-batch count FIFO.
+  std::deque<std::vector<FrameRef>> emitted_batches;
   // service LB steering state (see shim_set_lb)
   std::vector<uint32_t> lb_tab_keys;  // [cap*6]
   std::vector<int32_t> lb_tab_val;    // [cap]
@@ -353,6 +356,8 @@ int shim_feed_frame(Shim* s, const uint8_t* frame, uint32_t len,
   return 0;
 }
 
+static constexpr size_t kMaxUnverdictedBatches = 64;
+
 uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
                          ShimRecord* out_records, ShimTokens* out_tokens) {
   if (s->pending.empty()) return 0;
@@ -360,11 +365,21 @@ uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
   bool timed_out = now_us - s->first_pending_ts >= s->timeout_us;
   if (!full && !timed_out && !force) return 0;
   uint32_t n = std::min<size_t>(s->pending.size(), s->batch_size);
+  std::vector<FrameRef> frames;
+  frames.reserve(n);
   for (uint32_t i = 0; i < n; i++) {
     out_records[i] = s->pending.front().rec;
     out_tokens[i] = s->pending.front().tok;
-    s->emitted.push_back(s->pending.front().frame);
+    frames.push_back(s->pending.front().frame);
     s->pending.pop_front();
+  }
+  s->emitted_batches.push_back(std::move(frames));
+  while (s->emitted_batches.size() > kMaxUnverdictedBatches) {
+    for (const FrameRef& fr : s->emitted_batches.front()) {
+      s->stats.verdict_expired++;
+      if (fr.umem && s->rings_ready) ring_push_addr(s->fill, fr.addr);
+    }
+    s->emitted_batches.pop_front();
   }
   if (!s->pending.empty()) s->first_pending_ts = now_us;
   s->stats.batches_emitted++;
@@ -383,12 +398,14 @@ static void kick_tx(Shim* s) {
 
 void shim_apply_verdicts(Shim* s, const uint8_t* allow, uint32_t n) {
   bool sent = false;
+  std::vector<FrameRef> frames;
+  if (!s->emitted_batches.empty()) {
+    frames = std::move(s->emitted_batches.front());
+    s->emitted_batches.pop_front();
+  }
   for (uint32_t i = 0; i < n; i++) {
     FrameRef fr;
-    if (!s->emitted.empty()) {
-      fr = s->emitted.front();
-      s->emitted.pop_front();
-    }
+    if (i < frames.size()) fr = frames[i];
     if (allow[i]) {
       if (fr.umem && s->rings_ready) {
         // forward: hand the frame to the tx ring; the frame returns to the
@@ -410,6 +427,13 @@ void shim_apply_verdicts(Shim* s, const uint8_t* allow, uint32_t n) {
       s->stats.verdict_drops++;
       if (fr.umem && s->rings_ready) ring_push_addr(s->fill, fr.addr);
     }
+  }
+  // verdicts short of the batch's record count: the rest fail closed
+  // (dropped + recycled) rather than leaking frames
+  for (size_t i = n; i < frames.size(); i++) {
+    s->stats.verdict_drops++;
+    if (frames[i].umem && s->rings_ready)
+      ring_push_addr(s->fill, frames[i].addr);
   }
   if (sent) kick_tx(s);
 }

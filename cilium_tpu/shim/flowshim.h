@@ -67,6 +67,10 @@ typedef struct ShimStats {
   // allowed frames lost because the tx ring was full (NIC backpressure) —
   // counted separately from verdict_passes so tx loss is diagnosable
   uint64_t tx_full_drops;
+  // records whose batch aged out of the bounded unverdicted queue (a
+  // harvest-only consumer — tap mode, pcap replay — never calls
+  // shim_apply_verdicts; their umem frames recycle to the fill ring)
+  uint64_t verdict_expired;
 } ShimStats;
 
 typedef struct Shim Shim;  // opaque
