@@ -499,7 +499,22 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
         has_l7 = any(bool((hb["http_method"] != C.HTTP_METHOD_ANY).any()
                           or hb["http_path"].any()) for hb in host_dicts)
         has_v6 = any(bool(hb["is_v6"].any()) for hb in host_dicts)
-        if not has_l7 and not has_v6:
+        from cilium_tpu.kernels.records import (
+            PACKA_EP_SLOT_MAX, addr_dict_ratio, pack_batch_addrdict)
+        addr_ok = (not has_l7
+                   and all(addr_dict_ratio(hb) <= 0.5 for hb in host_dicts)
+                   and all(not (hb["ep_slot"] > PACKA_EP_SLOT_MAX).any()
+                           for hb in host_dicts))
+        if addr_ok:
+            # address-dictionary wire (12B/record + shared dict): pod-style
+            # traffic repeats addresses; one dict row count across batches
+            # keeps a single trace
+            rows = max(np.unique(np.concatenate(
+                [hb["src"], hb["dst"]]), axis=0).shape[0]
+                for hb in host_dicts)
+            host_batches = [pack_batch_addrdict(hb, min_addr_rows=rows)
+                            for hb in host_dicts]
+        elif not has_l7 and not has_v6:
             # compact 16B/record wire format — the transfer-bound fast path
             host_batches = [pack_batch_v4(hb) for hb in host_dicts]
         elif has_l7:
@@ -558,8 +573,10 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     # host↔TPU tunnel has a token-bucket shape (fast bursts, then a
     # ~100-150MB/s sustained floor), so a short window measures the bucket
     # state, not the framework. `value` reports the sustained median;
-    # `burst` the bucket-fresh rate. Compute-only separates the kernels
-    # from the link entirely.
+    # `burst` the EARLY rate — first measured pass after warmup, so setup
+    # transfers (tensor placement, the 1-batch warmup) have already drawn
+    # on the bucket; read it as an upper-bound indicator, not an absolute.
+    # Compute-only separates the kernels from the link entirely.
     burst_tp = batches * eff_batch / first_pass_s
     xfer_reps = max(1, min(50, int(0.3 / first_pass_s)))
     xfer_tp = []

@@ -114,7 +114,7 @@ def verify_configs(batch: int = 256,
         empty_batch, pack_batch, pack_batch_l7dict, pack_batch_v4)
 
     reports: List[ComboReport] = []
-    wire_formats = ("dict", "v4", "full", "l7dict")
+    wire_formats = ("dict", "v4", "full", "l7dict", "addr")
     lb_axis = (False,) if quick else (False, True)
     for v4_only, l7, lb, wire in itertools.product(
             (False, True), (False, True), lb_axis, wire_formats):
@@ -122,6 +122,8 @@ def verify_configs(batch: int = 256,
             continue                    # compact wire is v4/L7-free only
         if wire == "l7dict" and not l7:
             continue
+        if wire == "addr" and (v4_only or lb):
+            continue                    # one addr-dict combo per L7 state
         name = (f"{'v4only' if v4_only else 'dual'}"
                 f"{'+l7' if l7 else ''}{'+lb' if lb else ''}+{wire}")
         try:
@@ -145,6 +147,10 @@ def verify_configs(batch: int = 256,
             elif wire == "l7dict":
                 w, d = pack_batch_l7dict(b)
                 arg = (jnp.asarray(w), jnp.asarray(d))
+            elif wire == "addr":
+                from cilium_tpu.kernels.records import pack_batch_addrdict
+                arg = tuple(jnp.asarray(x)
+                            for x in pack_batch_addrdict(b, l7=l7))
             else:
                 arg = jnp.asarray(pack_batch(b, l7=l7))
             lowered = fn.lower(tensors, ct, arg, jnp.uint32(1000),

@@ -125,6 +125,10 @@ class ClusterMesh:
                 if doc.get("format_version") != FORMAT_VERSION:
                     log.warning("clustermesh: peer %s speaks format %r, "
                                 "skipped", node, doc.get("format_version"))
+                    # a real doc in an unknown format supersedes anything
+                    # cached — keeping serving the old doc would pin stale
+                    # identities for the lease duration
+                    self._last_good.pop(node, None)
                     continue
                 self._last_good[node] = (doc, now)
         for node, (doc, _ts) in list(self._last_good.items()):

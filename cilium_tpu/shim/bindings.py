@@ -139,6 +139,7 @@ class FlowShim:
         # must consume exactly that many per batch (short verdict arrays
         # would desync frames from verdicts; see apply_verdicts)
         self._pending_counts: list = []
+        self._enforcing = False        # mirrors flowshim.cc Shim::enforcing
 
     def close(self):
         if self._handle:
@@ -176,7 +177,8 @@ class FlowShim:
         if n == 0:
             return None
         self._pending_counts.append(int(n))
-        if len(self._pending_counts) > MAX_UNVERDICTED_BATCHES:
+        if not self._enforcing \
+                and len(self._pending_counts) > MAX_UNVERDICTED_BATCHES:
             self._pending_counts.pop(0)   # C++ aged out the same batch
         b = empty_batch(self.batch_size)
         b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
@@ -212,6 +214,7 @@ class FlowShim:
         or later verdicts would enforce on the wrong frames."""
         if not self._pending_counts:
             raise RuntimeError("apply_verdicts without a harvested batch")
+        self._enforcing = True
         n = self._pending_counts.pop(0)
         arr = np.zeros((n,), dtype=np.uint8)
         k = min(n, int(np.asarray(allow).shape[0]))

@@ -166,6 +166,7 @@ struct Shim {
   // recycled, counted in verdict_expired). The Python binding mirrors
   // kMaxUnverdictedBatches for its per-batch count FIFO.
   std::deque<std::vector<FrameRef>> emitted_batches;
+  bool enforcing = false;  // a verdict was applied → never age batches out
   // service LB steering state (see shim_set_lb)
   std::vector<uint32_t> lb_tab_keys;  // [cap*6]
   std::vector<int32_t> lb_tab_val;    // [cap]
@@ -374,7 +375,12 @@ uint32_t shim_poll_batch(Shim* s, uint64_t now_us, int force,
     s->pending.pop_front();
   }
   s->emitted_batches.push_back(std::move(frames));
-  while (s->emitted_batches.size() > kMaxUnverdictedBatches) {
+  // age out ONLY for harvest-only consumers: once a verdict has ever been
+  // applied the consumer is enforcing, and evicting would desync every
+  // later verdict onto the wrong batch's frames (off-by-one enforcement —
+  // worse than unbounded growth, which backpressure bounds in practice)
+  while (!s->enforcing &&
+         s->emitted_batches.size() > kMaxUnverdictedBatches) {
     for (const FrameRef& fr : s->emitted_batches.front()) {
       s->stats.verdict_expired++;
       if (fr.umem && s->rings_ready) ring_push_addr(s->fill, fr.addr);
@@ -397,6 +403,7 @@ static void kick_tx(Shim* s) {
 }
 
 void shim_apply_verdicts(Shim* s, const uint8_t* allow, uint32_t n) {
+  s->enforcing = true;
   bool sent = false;
   std::vector<FrameRef> frames;
   if (!s->emitted_batches.empty()) {

@@ -341,3 +341,50 @@ class TestKernelOracleParity:
                 prior.append(p)
             now += 13
         assert extract_device_ct(ct_dev, now) == oracle_live_ct(oracle, now)
+
+
+def test_addrdict_wire_bit_identical():
+    """The address-dictionary wire (12B/record + shared unique-address
+    table) must match the dict path exactly — outputs and CT state — for
+    mixed v4/v6 and for L7-token traffic (the 4-word variant)."""
+    from cilium_tpu.kernels.classify import make_classify_fn
+    from cilium_tpu.kernels.records import (
+        pack_batch_addrdict, unpack_batch_addrdict_jnp)
+
+    rng = random.Random(12)
+    ctx, repo, eps = build_world()
+    snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=4096))
+    tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+    make_ct = lambda: {k: jnp.asarray(v) for k, v in  # noqa: E731
+                       make_ct_arrays(CTConfig(capacity=4096)).items()}
+    ct_a, ct_b = make_ct(), make_ct()
+    fn_dict = make_classify_fn(donate_ct=False)
+    fn_packed = make_classify_fn(donate_ct=False, packed=True)
+    prior = []
+    now = 700
+    for bi in range(3):
+        packets = [random_packet(rng, prior) for _ in range(64)]
+        raw = batch_from_records(packets, snap.ep_slot_of)
+        # roundtrip incl. L7 variant
+        parts = pack_batch_addrdict(raw, l7=True)
+        unpacked = unpack_batch_addrdict_jnp(
+            *(jnp.asarray(p) for p in parts))
+        for k in raw:
+            np.testing.assert_array_equal(
+                np.asarray(unpacked[k]).astype(raw[k].dtype), raw[k], k)
+        out_a, ct_a, _ = fn_dict(
+            tensors, ct_a, {k: jnp.asarray(v) for k, v in raw.items()},
+            jnp.uint32(now), jnp.int32(snap.world_index))
+        wire = pack_batch_addrdict(raw)
+        out_b, ct_b, _ = fn_packed(
+            tensors, ct_b, tuple(jnp.asarray(p) for p in wire),
+            jnp.uint32(now), jnp.int32(snap.world_index))
+        for k in out_a:
+            np.testing.assert_array_equal(np.asarray(out_a[k]),
+                                          np.asarray(out_b[k]), k)
+        for k in ct_a:
+            np.testing.assert_array_equal(np.asarray(ct_a[k]),
+                                          np.asarray(ct_b[k]), k)
+        prior.extend(packets)
+        prior = prior[-100:]
+        now += 40
