@@ -20,12 +20,44 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
 
 PER_CHIP_TARGET = 10e6 / 8  # north-star flows/sec per chip
+
+# Watchdog: this rig's host↔TPU tunnel can wedge mid-run (a device op
+# never completes; the process freezes in a futex wait). A hung benchmark
+# reports nothing — worse than a partial report. The watchdog emits the
+# best-effort JSON line from whatever completed and exits.
+WATCHDOG_DEADLINE_S = float(os.environ.get(
+    "CILIUM_TPU_BENCH_DEADLINE_S", 2400))
+_progress: dict = {"headline": None, "configs": {}}
+
+
+def _start_watchdog(headline_metric: str) -> None:
+    if WATCHDOG_DEADLINE_S <= 0:
+        return                          # 0/negative = watchdog disabled
+
+    def fire():
+        time.sleep(WATCHDOG_DEADLINE_S)
+        doc = _progress["headline"] or {
+            "metric": f"flow_classify_throughput_{headline_metric}",
+            "value": 0, "unit": "flows/sec/chip", "vs_baseline": 0,
+        }
+        doc = dict(doc)
+        doc["watchdog_timeout"] = True
+        doc["error"] = (f"bench stalled past {WATCHDOG_DEADLINE_S:.0f}s "
+                        "(tunnel wedge); partial results reported")
+        if _progress["configs"]:
+            doc["configs"] = _progress["configs"]
+        print(json.dumps(doc), flush=True)
+        os._exit(3)
+    threading.Thread(target=fire, daemon=True,
+                     name="bench-watchdog").start()
 
 
 # --------------------------------------------------------------------------- #
@@ -500,19 +532,23 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
                           or hb["http_path"].any()) for hb in host_dicts)
         has_v6 = any(bool(hb["is_v6"].any()) for hb in host_dicts)
         from cilium_tpu.kernels.records import (
-            PACKA_EP_SLOT_MAX, addr_dict_ratio, pack_batch_addrdict)
-        addr_ok = (not has_l7
-                   and all(addr_dict_ratio(hb) <= 0.5 for hb in host_dicts)
+            PACKA_EP_SLOT_MAX, _pad_dict_rows, pack_batch_addrdict)
+        # addr-dict selection by BYTE COST vs the wire it would displace
+        # (16B/record v4, or 44B/record full for v6): the dict only wins
+        # when addresses repeat enough to pay for the dict rows
+        u_max = 0 if has_l7 else max(
+            np.unique(np.concatenate([hb["src"], hb["dst"]]),
+                      axis=0).shape[0] for hb in host_dicts)
+        u_pad = _pad_dict_rows(u_max, 1)
+        addr_bytes = 12 * batch + 16 * u_pad
+        alt_bytes = (44 if has_v6 else 16) * batch
+        addr_ok = (not has_l7 and 0 < u_max <= 65536
+                   and addr_bytes < alt_bytes
                    and all(not (hb["ep_slot"] > PACKA_EP_SLOT_MAX).any()
                            for hb in host_dicts))
         if addr_ok:
-            # address-dictionary wire (12B/record + shared dict): pod-style
-            # traffic repeats addresses; one dict row count across batches
-            # keeps a single trace
-            rows = max(np.unique(np.concatenate(
-                [hb["src"], hb["dst"]]), axis=0).shape[0]
-                for hb in host_dicts)
-            host_batches = [pack_batch_addrdict(hb, min_addr_rows=rows)
+            # one dict row count across batches keeps a single trace
+            host_batches = [pack_batch_addrdict(hb, min_addr_rows=u_pad)
                             for hb in host_dicts]
         elif not has_l7 and not has_v6:
             # compact 16B/record wire format — the transfer-bound fast path
@@ -719,10 +755,12 @@ def main(argv=None):
     batch = args.batch or (4096 if preset == "smoke" else 65536)
     batches = args.batches or (10 if preset == "smoke" else 40)
 
+    _start_watchdog(METRIC_NAMES[args.config])
     result = run_bench(args.config, preset, batch, batches,
                        verbose=args.verbose, windows=args.windows,
                        shards=args.shards, rule_shards=args.rule_shards,
                        profile_dir=args.profile)
+    _progress["headline"] = result
     if args.shards * args.rule_shards > 1:
         args.only = True       # the sweep is a single-chip comparison series
     if not args.only:
@@ -743,6 +781,7 @@ def main(argv=None):
                 "value": res["value"], "vs_baseline": res["vs_baseline"],
                 "p50_batch_ms": res["p50_batch_ms"],
                 "p99_batch_ms": res["p99_batch_ms"]}
+            _progress["configs"] = configs
         result["configs"] = configs
         result["update_latency"] = update_latency_bench(preset)
     print(json.dumps(result))

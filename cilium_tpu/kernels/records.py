@@ -216,6 +216,13 @@ PACK4_L7_WORDS = PACK4_WORDS + 1
 PACK_L7DICT_WORDS = PACK_WORDS + 1
 
 
+def _pad_dict_rows(count: int, min_rows: int) -> int:
+    """Dictionary row padding: next power of two ≥ max(count, min_rows) —
+    shared by the path and address dictionaries so trace-shape policy can't
+    silently diverge between them."""
+    return 1 << max(0, (max(count, min_rows) - 1)).bit_length()
+
+
 def _pack_path_dict(paths: np.ndarray, path_words: Optional[int],
                     min_rows: int = 1) -> Tuple[np.ndarray, np.ndarray]:
     """[N, 64] uint8 → (dict_words [U_pow2, P] uint32, index [N] int64).
@@ -229,7 +236,7 @@ def _pack_path_dict(paths: np.ndarray, path_words: Optional[int],
     path_words = min(path_words, C.L7_PATH_MAXLEN // 4)
     if uniq[:, 4 * path_words:].any():
         raise ValueError(f"path_words={path_words} truncates a path")
-    u_pad = 1 << max(0, (max(uniq.shape[0], min_rows) - 1)).bit_length()
+    u_pad = _pad_dict_rows(uniq.shape[0], min_rows)
     p = np.zeros((u_pad, 4 * path_words), dtype=np.uint32)
     p[:uniq.shape[0]] = uniq[:, :4 * path_words]
     p = p.reshape(u_pad, path_words, 4)
@@ -341,7 +348,7 @@ def pack_batch_addrdict(b: BatchArrays, l7: Optional[bool] = None,
                           return_inverse=True)
     if uniq.shape[0] > 65536:
         raise ValueError("address dictionary overflow (>64k unique)")
-    u_pad = 1 << max(0, (max(uniq.shape[0], min_addr_rows) - 1)).bit_length()
+    u_pad = _pad_dict_rows(uniq.shape[0], min_addr_rows)
     addr_dict = np.zeros((u_pad, 4), dtype=np.uint32)
     addr_dict[:uniq.shape[0]] = uniq
     src_idx = inv[:n].astype(np.uint32)
