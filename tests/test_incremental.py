@@ -392,3 +392,32 @@ class TestEndpointGate:
         eng.remove_endpoint(2)
         snap = eng.regenerate().snapshot
         assert 2 not in snap.ep_slot_of
+
+
+class TestMoreGates:
+    def test_enforcement_mode_change_gates(self):
+        """Runtime enforcement-mode change (PATCH /v1/config path) must not
+        be absorbed by the incremental compiler — it rewrites every plane."""
+        ctx, repo, eps = make_world()
+        repo.add([l4_rule("web0", 0, 80)])
+        snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
+        inc = IncrementalCompiler(repo, ctx, eps, snap)
+        ctx.enforcement_mode = C.ENFORCEMENT_NEVER
+        assert inc.try_update(CTConfig(capacity=1024)) is None
+        assert inc.last_fallback == "enforcement-mode-changed"
+
+    def test_endpoint_gate_via_param(self):
+        """The endpoints kwarg drives the endpoint-set gate."""
+        ctx, repo, eps = make_world()
+        repo.add([l4_rule("web0", 0, 80)])
+        snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
+        inc = IncrementalCompiler(repo, ctx, eps, snap)
+        grown = list(eps) + [Endpoint(ep_id=99, labels=eps[0].labels,
+                                      identity_id=eps[0].identity_id)]
+        assert inc.try_update(CTConfig(capacity=1024),
+                              endpoints=grown) is None
+        assert inc.last_fallback == "endpoint-set-changed"
+        # unchanged set still patches
+        repo.add([l4_rule("web0", 1, 443)])
+        assert inc.try_update(CTConfig(capacity=1024),
+                              endpoints=eps) is not None
