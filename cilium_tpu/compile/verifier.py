@@ -157,15 +157,7 @@ def verify_configs(batch: int = 256,
                                jnp.int32(snap.world_index))
             compiled = lowered.compile()
             stats = _memory_stats(compiled)
-            rep = ComboReport(name=name, ok=True, **stats)
-            if max_hbm_bytes is not None and \
-                    stats["argument_bytes"] + stats["temp_bytes"] \
-                    > max_hbm_bytes:
-                rep.ok = False
-                rep.error = (f"memory budget exceeded: "
-                             f"{stats['argument_bytes'] + stats['temp_bytes']}"
-                             f" > {max_hbm_bytes}")
-            reports.append(rep)
+            reports.append(ComboReport(name=name, ok=True, **stats))
         except Exception as e:          # compile failure = verifier reject
             reports.append(ComboReport(name=name, ok=False, error=repr(e)))
     # the sharded program (rule-axis psum) is covered by dryrun_multichip;
@@ -180,10 +172,32 @@ def verify_configs(batch: int = 256,
         b = empty_batch(batch)
         fn = make_classify_fn(v4_only=True, donate_ct=False)
         arg = {k: jnp.asarray(v) for k, v in b.items()}
-        fn.lower(tensors, ct, arg, jnp.uint32(1000),
-                 jnp.int32(snap.world_index)).compile()
-        reports.append(ComboReport(name="rule-padded", ok=True))
+        compiled = fn.lower(tensors, ct, arg, jnp.uint32(1000),
+                            jnp.int32(snap.world_index)).compile()
+        reports.append(ComboReport(name="rule-padded", ok=True,
+                                   **_memory_stats(compiled)))
     except Exception as e:
         reports.append(ComboReport(name="rule-padded", ok=False,
                                    error=repr(e)))
+    if max_hbm_bytes is not None:
+        reports = apply_budget(reports, max_hbm_bytes)
     return reports
+
+
+def apply_budget(reports: List[ComboReport],
+                 max_hbm_bytes: int) -> List[ComboReport]:
+    """Post-process a sweep's memory stats against an HBM budget — pure
+    function of the reports, so one compile sweep serves any number of
+    budget policies (CI reuses a single sweep)."""
+    import dataclasses
+    out = []
+    for r in reports:
+        total = r.argument_bytes + r.temp_bytes
+        if r.ok and total > max_hbm_bytes:
+            r = dataclasses.replace(
+                r, ok=False,
+                error=f"memory budget exceeded: {total} > {max_hbm_bytes}")
+        else:
+            r = dataclasses.replace(r)   # never alias the input reports
+        out.append(r)
+    return out

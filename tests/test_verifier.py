@@ -8,24 +8,33 @@ import sys
 
 import pytest
 
-from cilium_tpu.compile.verifier import verify_configs
+from cilium_tpu.compile.verifier import apply_budget, verify_configs
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    # ONE compile sweep serves every assertion (budget checks are pure
+    # post-processing of the memory stats)
+    return verify_configs(batch=64, quick=True)
 
 
 class TestVerifier:
-    def test_all_combos_compile(self):
-        reports = verify_configs(batch=64, quick=True)
-        assert len(reports) >= 10
-        bad = [(r.name, r.error) for r in reports if not r.ok]
+    def test_all_combos_compile(self, sweep):
+        assert len(sweep) >= 10
+        bad = [(r.name, r.error) for r in sweep if not r.ok]
         assert not bad, bad
-        names = {r.name for r in reports}
+        names = {r.name for r in sweep}
         # the key shapes are all present
         assert "v4only+v4" in names
         assert "dual+l7+l7dict" in names
+        assert "dual+addr" in names
         assert "rule-padded" in names
 
-    def test_memory_budget_rejects(self):
-        reports = verify_configs(batch=64, max_hbm_bytes=1, quick=True)
+    def test_memory_budget_rejects(self, sweep):
+        reports = apply_budget(sweep, max_hbm_bytes=1)
         assert any(not r.ok and "memory budget" in r.error for r in reports)
+        # the original sweep is budget-free and still all-ok
+        assert all(r.ok for r in sweep)
 
     def test_cli_verify(self):
         out = subprocess.run(
