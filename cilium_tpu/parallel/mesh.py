@@ -234,7 +234,6 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
     steered (steer_batch) and verdict rows padded (pad_snapshot_tensors).
     """
     import jax
-    import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 

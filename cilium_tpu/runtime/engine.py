@@ -31,7 +31,7 @@ from cilium_tpu.model.endpoint import Endpoint
 from cilium_tpu.model.identity import IdentityAllocator
 from cilium_tpu.model.ipcache import IPCache
 from cilium_tpu.model.labels import Labels
-from cilium_tpu.model.rules import Rule, parse_rules
+from cilium_tpu.model.rules import parse_rules
 from cilium_tpu.model.services import ServiceRegistry
 from cilium_tpu.policy.repository import PolicyContext, Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
