@@ -8,13 +8,23 @@
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast shim bench clean
+.PHONY: test test-fast chaos shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
 
 test-fast:
 	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
+
+# Scripted fault-injection scenario (runtime/faults.py): regen failure storm
+# → last-good serving + DEGRADED, clustermesh peer flap → ipcache
+# convergence, corrupt checkpoint → cold-start fallback. Runs the scenario
+# through the real jit datapath twice: directly via the CLI (prints the
+# verdict-continuity report) and as the slow-marked pytest. A fast subset on
+# the fake datapath runs in tier-1 (tests/test_faults.py).
+chaos:
+	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
+	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 
 shim:
 	$(MAKE) -C cilium_tpu/shim

@@ -65,12 +65,16 @@ def cmd_agent_run(args) -> int:
     restored = False
     if state_dir and os.path.exists(os.path.join(state_dir, "state.json")):
         try:
-            ckpt.restore(engine, state_dir)
-            restored = True
-            log.info("restored state from %s (revision %d, %d endpoints)",
-                     state_dir, engine.repo.revision, len(engine.endpoints))
+            # a corrupt checkpoint returns False (cold start) — only an
+            # unexpected error (bad engine state, device failure) raises
+            restored = ckpt.restore(engine, state_dir)
         except Exception:
             log.exception("state restore failed; starting empty")
+        if restored:
+            log.info("restored state from %s (revision %d, %d endpoints)",
+                     state_dir, engine.repo.revision, len(engine.endpoints))
+        else:
+            log.warning("checkpoint at %s unusable; cold start", state_dir)
     engine.regenerate(force=True)
     engine.start_background()
     if config.api_socket:

@@ -119,6 +119,50 @@ def register(sub: "argparse._SubParsersAction") -> None:
     p.set_defaults(func=_cmd_verify)
 
     p = sub.add_parser(
+        "faults", help="fault injection: list points, arm/disarm on a live "
+                       "agent, run the scripted chaos scenario")
+    fsub = p.add_subparsers(dest="subcmd", required=True)
+    fl = fsub.add_parser("list", help="list injection points (+ live stats "
+                                      "with --api)")
+    fl.add_argument("--api", metavar="SOCKET",
+                    help="read fire/trip stats from a running agent")
+    fl.add_argument("-o", "--output", choices=["text", "json"],
+                    default="text")
+    fl.set_defaults(func=_cmd_faults_list)
+    fa = fsub.add_parser("arm", help="arm injection points on a live agent")
+    fa.add_argument("--api", metavar="SOCKET", required=True)
+    fa.add_argument("spec", help="CILIUM_TPU_FAULTS grammar, e.g. "
+                                 "'regen.compile=fail:10'")
+    fa.set_defaults(func=_cmd_faults_arm)
+    fd = fsub.add_parser("disarm", help="disarm injection points on a live "
+                                        "agent")
+    fd.add_argument("--api", metavar="SOCKET", required=True)
+    fd.add_argument("point", nargs="?", default="*",
+                    help="point to disarm (default: all)")
+    fd.set_defaults(func=_cmd_faults_disarm)
+    fc = fsub.add_parser(
+        "chaos", help="run the scripted chaos scenario and print the "
+                      "verdict-continuity report (exit 1 on any classify "
+                      "error or missed recovery). In-process mode runs all "
+                      "four phases (regen storm/recovery, peer flap, "
+                      "checkpoint corruption); --api mode runs the regen "
+                      "storm + recovery against the live agent only")
+    fc.add_argument("--api", metavar="SOCKET",
+                    help="target a running agent over its REST socket: "
+                         "regen storm/recovery phases only (default: a "
+                         "self-contained in-process engine, all phases)")
+    fc.add_argument("--failures", type=int, default=10,
+                    help="length of the regen.compile failure storm")
+    fc.add_argument("--seed", type=int, default=7,
+                    help="RNG seed for probabilistic fault phases")
+    fc.add_argument("--datapath", choices=["jit", "fake"], default="jit",
+                    help="in-process mode: device path (jit) or the "
+                         "oracle-backed fake")
+    fc.add_argument("-o", "--output", choices=["text", "json"],
+                    default="text")
+    fc.set_defaults(func=_cmd_faults_chaos)
+
+    p = sub.add_parser(
         "map", help="compiled policy-map inspection (cilium bpf policy get)")
     msub = p.add_subparsers(dest="subcmd", required=True)
     mg = msub.add_parser("get", help="dump one endpoint's MapState entries")
@@ -625,3 +669,269 @@ def _cmd_map_get(args) -> int:
             l7 = f" l7={e['l7_rules']}" if e["l7_rules"] else ""
             print(f"{e['direction']:<8} {e['key']:<40} {e['action']}{l7}")
     return _emit(args, doc, text)
+
+
+# --------------------------------------------------------------------------- #
+# fault injection / chaos (runtime/faults.py — supervised degradation proof)
+# --------------------------------------------------------------------------- #
+def _cmd_faults_list(args) -> int:
+    if args.api:
+        doc = _live(args, "GET", "/v1/faults")
+    else:
+        # the local singleton: same schema as the live route, and it
+        # reflects a CILIUM_TPU_FAULTS set in this process's environment
+        from cilium_tpu.runtime.faults import FAULTS
+        doc = FAULTS.stats()
+
+    def text(d):
+        for point in sorted(d):
+            st = d[point]
+            armed = f"armed={st.get('mode')}" if st.get("armed") else "idle"
+            print(f"{point:<24} {armed:<12} fired={st.get('fired', 0):<6} "
+                  f"trips={st.get('trips', 0):<6} {st.get('description', '')}")
+    return _emit(args, doc, text)
+
+
+def _cmd_faults_arm(args) -> int:
+    doc = _live(args, "POST", "/v1/faults", {"spec": args.spec})
+    print(json.dumps(doc))
+    return 0
+
+
+def _cmd_faults_disarm(args) -> int:
+    doc = _live(args, "POST", "/v1/faults", {"disarm": args.point})
+    print(json.dumps(doc))
+    return 0
+
+
+class _ChaosReport:
+    """Phase-by-phase pass/fail accumulator for the chaos scenario."""
+
+    def __init__(self):
+        self.phases = []
+
+    def record(self, phase: str, ok: bool, detail: str) -> bool:
+        self.phases.append({"phase": phase, "ok": bool(ok), "detail": detail})
+        return bool(ok)
+
+    @property
+    def ok(self) -> bool:
+        return all(p["ok"] for p in self.phases)
+
+
+_CHAOS_POLICY = [{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toCIDR": ["10.0.0.0/8"],
+                "toPorts": [{"ports": [{"port": "443",
+                                        "protocol": "TCP"}]}]}],
+}]
+
+
+def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
+                     report: _ChaosReport) -> None:
+    """Self-contained chaos scenario: build an engine, then prove verdict
+    continuity under a regen failure storm, ipcache convergence under peer
+    flaps, and cold-start fallback from a corrupted checkpoint."""
+    import shutil
+    import tempfile
+
+    from cilium_tpu.kernels.records import batch_from_records
+    from cilium_tpu.runtime import checkpoint as ckpt
+    from cilium_tpu.runtime.clustermesh import ClusterMesh
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.engine import Engine
+    from cilium_tpu.runtime.faults import FAULTS, FaultInjected
+    from cilium_tpu.utils.ip import parse_addr
+    from oracle import PacketRecord
+
+    FAULTS.reset()
+
+    def mk_engine():
+        cfg = DaemonConfig(ct_capacity=4096, auto_regen=False)
+        dp = None
+        if datapath_kind == "fake":
+            from cilium_tpu.runtime.datapath import FakeDatapath
+            dp = FakeDatapath(cfg)
+        return Engine(cfg, datapath=dp)
+
+    def mk_batch(slot_of):
+        s16, _ = parse_addr("192.168.1.10")
+        recs = []
+        for dst, dport in (("10.1.2.3", 443),    # allowed
+                           ("10.1.2.3", 80),     # denied port
+                           ("8.8.8.8", 443)):    # denied CIDR
+            d16, _ = parse_addr(dst)
+            recs.append(PacketRecord(s16, d16, 40000 + dport, dport,
+                                     C.PROTO_TCP, C.TCP_SYN, False, 1,
+                                     C.DIR_EGRESS))
+        return batch_from_records(recs, slot_of)
+
+    eng = mk_engine()
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+    eng.apply_policy(_CHAOS_POLICY)
+    slot_of = eng.active.snapshot.ep_slot_of
+    base = eng.classify(mk_batch(slot_of), now=100)
+    baseline = [bool(a) for a in base["allow"]]
+
+    # -- phase 1: regen.compile failure storm -------------------------------
+    # every classify re-enters the failing compile (dirty engine) and must
+    # still answer from the last-good snapshot, bit-identical to baseline
+    FAULTS.arm("regen.compile", mode="fail", times=failures)
+    classify_errors = divergences = 0
+    for i in range(failures):
+        eng._mark_dirty()                        # noqa: SLF001 — chaos driver
+        try:
+            out = eng.classify(mk_batch(slot_of), now=200 + i)
+        except Exception:
+            classify_errors += 1
+            continue
+        if [bool(a) for a in out["allow"]] != baseline:
+            divergences += 1
+    h = eng.health()
+    report.record(
+        "regen-storm",
+        classify_errors == 0 and divergences == 0
+        and h["state"] == C.HEALTH_DEGRADED
+        and h["consecutive_regen_failures"] == failures,
+        f"{failures} injected compile failures: {classify_errors} classify "
+        f"errors, {divergences} verdict divergences, health={h['state']} "
+        f"consecutive={h['consecutive_regen_failures']}")
+
+    # -- phase 2: recovery --------------------------------------------------
+    FAULTS.disarm("regen.compile")
+    eng.regenerate(force=True)
+    h = eng.health()
+    report.record(
+        "regen-recovery",
+        h["state"] == C.HEALTH_OK
+        and h["consecutive_regen_failures"] == 0,
+        f"post-storm regenerate: health={h['state']} "
+        f"consecutive={h['consecutive_regen_failures']}")
+
+    # -- phase 3: clustermesh peer flap (+ skewed peer clock) ---------------
+    store = tempfile.mkdtemp(prefix="cilium-tpu-chaos-mesh-")
+    try:
+        mesh = ClusterMesh(eng, store, "local", stale_after_s=300.0)
+        peer = os.path.join(store, "peer1.json")
+
+        def publish_peer(gen):
+            doc = {"format_version": 1, "node": "peer1", "generation": gen,
+                   "published_at": 0.0,          # peer clock wildly behind
+                   "entries": {"10.99.0.5/32": {"labels": ["k8s:app=db"]}}}
+            with open(peer + ".tmp", "w") as f:
+                json.dump(doc, f)
+            os.replace(peer + ".tmp", peer)
+
+        publish_peer(1)
+        mesh.sync()
+        present0 = eng.ctx.ipcache.get("10.99.0.5/32") is not None
+        FAULTS.arm("clustermesh.peer_read", mode="prob", prob=0.5, seed=seed)
+        rounds, lost = 12, 0
+        for gen in range(2, 2 + rounds):
+            publish_peer(gen)
+            mesh.sync()
+            if eng.ctx.ipcache.get("10.99.0.5/32") is None:
+                lost += 1
+        FAULTS.disarm("clustermesh.peer_read")
+        mesh.sync()
+        present1 = eng.ctx.ipcache.get("10.99.0.5/32") is not None
+        report.record(
+            "peer-flap",
+            present0 and present1 and lost == 0,
+            f"{rounds} sync rounds at 50% peer-read failure (peer clock "
+            f"skewed to epoch): entry lost in {lost} rounds, "
+            f"converged={present1}")
+    finally:
+        shutil.rmtree(store, ignore_errors=True)
+
+    # -- phase 4: checkpoint torn write + corruption fallback ---------------
+    state = tempfile.mkdtemp(prefix="cilium-tpu-chaos-ckpt-")
+    try:
+        FAULTS.arm("checkpoint.write", mode="fail", times=1)
+        torn = False
+        try:
+            ckpt.save(eng, state)
+        except FaultInjected:
+            torn = True
+        FAULTS.disarm("checkpoint.write")
+        no_partial = not os.path.exists(os.path.join(state, "state.json"))
+        ckpt.save(eng, state)                    # clean write
+        with open(os.path.join(state, "state.json"), "r+") as f:
+            f.write("{corrupt")                  # simulate torn write/bit rot
+        fresh = mk_engine()
+        restored = ckpt.restore(fresh, state)
+        cold_ok = False
+        if restored is False:                    # cold start must still work
+            fresh.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",),
+                               ep_id=1)
+            fresh.apply_policy(_CHAOS_POLICY)
+            out = fresh.classify(
+                mk_batch(fresh.active.snapshot.ep_slot_of), now=400)
+            cold_ok = [bool(a) for a in out["allow"]] == baseline
+        report.record(
+            "checkpoint-corruption",
+            torn and no_partial and restored is False and cold_ok,
+            f"torn save aborted cleanly={torn and no_partial}, corrupt "
+            f"restore fell back to cold start={restored is False}, cold "
+            f"engine verdicts match baseline={cold_ok}")
+    finally:
+        shutil.rmtree(state, ignore_errors=True)
+
+
+def _chaos_live(args, report: _ChaosReport) -> None:
+    """Drive the chaos scenario against a running agent over its REST
+    socket (arm via POST /v1/faults — the route is exempt from the
+    ``api.handler`` point so the driver keeps control during the storm)."""
+    from cilium_tpu.runtime.api import UnixAPIClient
+    failures = args.failures
+    client = UnixAPIClient(args.api)
+
+    code, h0 = client.get("/v1/healthz")
+    if not report.record("baseline",
+                         code == 200 and h0.get("state") == C.HEALTH_OK,
+                         f"healthz={code} state={h0.get('state')}"):
+        return
+    code, doc = client.post("/v1/faults",
+                            {"spec": f"regen.compile=fail:{failures}"})
+    if not report.record("arm", code == 200, f"arm regen.compile: {doc}"):
+        return
+    regen_errors = 0
+    for _ in range(failures):
+        code, _doc = client.post("/v1/regenerate")
+        if code != 200:                          # degraded regen still
+            regen_errors += 1                    # answers with last-good
+    code_p, _probe = client.get("/v1/health")    # real classify continuity
+    code, h1 = client.get("/v1/healthz")
+    report.record(
+        "regen-storm",
+        regen_errors == 0 and code_p == 200 and code == 200
+        and h1.get("state") in (C.HEALTH_DEGRADED, C.HEALTH_STALE)
+        and h1.get("consecutive_regen_failures") == failures,
+        f"{failures} forced regens: {regen_errors} API errors, datapath "
+        f"probe={code_p}, health={h1.get('state')} "
+        f"consecutive={h1.get('consecutive_regen_failures')}")
+    client.post("/v1/faults", {"disarm": "*"})
+    code, _doc = client.post("/v1/regenerate")
+    code2, h2 = client.get("/v1/healthz")
+    report.record(
+        "regen-recovery",
+        code == 200 and code2 == 200 and h2.get("state") == C.HEALTH_OK,
+        f"post-storm regenerate={code}, health={h2.get('state')}")
+
+
+def _cmd_faults_chaos(args) -> int:
+    report = _ChaosReport()
+    if args.api:
+        _chaos_live(args, report)
+    else:
+        _chaos_inprocess(args.failures, args.seed, args.datapath, report)
+    if args.output == "json":
+        print(json.dumps({"ok": report.ok, "phases": report.phases},
+                         indent=2))
+    else:
+        for p in report.phases:
+            print(f"{'PASS' if p['ok'] else 'FAIL'} {p['phase']:<22} "
+                  f"{p['detail']}")
+        print("chaos scenario PASSED — verdict continuity held under all "
+              "injected faults" if report.ok else "chaos scenario FAILED")
+    return 0 if report.ok else 1

@@ -60,6 +60,11 @@ class IPCache:
         with self._lock:
             return dict(self._entries)
 
+    def get(self, prefix: str) -> Optional[int]:
+        """Exact-prefix entry lookup (None if absent); NOT an LPM match."""
+        with self._lock:
+            return self._entries.get(normalize_prefix(prefix))
+
     def lookup(self, addr: str) -> int:
         """Host-side reference LPM lookup (slow; the device LPM tensor must
         agree with this exactly — the oracle uses it)."""

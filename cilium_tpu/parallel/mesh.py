@@ -234,7 +234,15 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
     steered (steer_batch) and verdict rows padded (pad_snapshot_tensors).
     """
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                 # jax < 0.6: experimental location
+        from jax.experimental.shard_map import shard_map
+    import inspect
+    # the replication-check kwarg was renamed check_rep → check_vma
+    _check_kw = ("check_vma"
+                 if "check_vma" in inspect.signature(shard_map).parameters
+                 else "check_rep")
     from jax.sharding import PartitionSpec as P
 
     from cilium_tpu.kernels.classify import classify_step
@@ -286,7 +294,7 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                 local_fn, mesh=mesh,
                 in_specs=(tensors_spec, ct_spec, batch_spec, P(), P()),
                 out_specs=(out_spec, ct_spec, counters_spec),
-                check_vma=False,
+                **{_check_kw: False},
             ), donate_argnums=(1,) if donate_ct else ())
             jits[keyset] = fn
         return fn(tensors, ct, batch, now, world_index)

@@ -8,7 +8,8 @@ the offline CLI, which reads checkpoint files; `cilium-tpu --api <sock>`
 drives these routes (cli/commands.py).
 
 Routes (all under /v1):
-  GET  /v1/healthz            liveness + policy revision
+  GET  /v1/healthz            liveness + policy revision + degradation state
+                              (OK/DEGRADED/STALE, consecutive regen failures)
   GET  /v1/status             agent summary (endpoints/identities/rules/CT)
   GET  /v1/endpoints          endpoint list
   GET  /v1/endpoints/<id>     one endpoint incl. per-direction policy size
@@ -26,17 +27,22 @@ Routes (all under /v1):
                               config PolicyEnforcement=...`)
   GET  /v1/health             datapath health probe through real classify
   POST /v1/regenerate         force a recompile
+  GET  /v1/faults             fault-injection point list + fire/trip stats
+  POST /v1/faults             arm ({"spec": "point=mode:..."}) or disarm
+                              ({"disarm": "*"}) injection points (chaos CLI)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import socket
 import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler
 from typing import TYPE_CHECKING, Dict, Optional, Tuple
 
+from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
 
 if TYPE_CHECKING:
@@ -61,17 +67,63 @@ class APIServer:
         if self._server is not None:
             return
         d = os.path.dirname(self.socket_path)
-        if d:
-            os.makedirs(d, exist_ok=True)
+        if d and not os.path.isdir(d):
+            # owner + group only: the socket dir is the auth boundary
+            # (upstream: /var/run/cilium is root:cilium 0750). chmod after
+            # makedirs because the mode= arg is masked by the umask; only
+            # dirs WE create are tightened — never a pre-existing shared
+            # parent like /tmp
+            os.makedirs(d, mode=0o750, exist_ok=True)
+            os.chmod(d, 0o750)
         if os.path.exists(self.socket_path):
-            os.unlink(self.socket_path)          # stale socket from a crash
+            # probe before unlinking: a live server answering on the path
+            # means another agent owns it — error out instead of silently
+            # stealing its socket (two agents would corrupt each other's
+            # state dir); only a dead leftover from a crash is removed
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(0.5)
+            try:
+                probe.connect(self.socket_path)
+            except (ConnectionRefusedError, FileNotFoundError):
+                # ECONNREFUSED is the only proof of death (nobody accepts
+                # on the path — a crashed agent's leftover, or a stray
+                # regular file); reclaim it. ENOENT means the owner removed
+                # it between our exists() check and the probe — same unlink,
+                # but the path may already be gone
+                try:
+                    os.unlink(self.socket_path)
+                except FileNotFoundError:
+                    pass
+            except OSError as e:
+                # timeout / EAGAIN / EACCES: a live-but-busy owner (e.g.
+                # mid-compile with a full backlog) looks exactly like this
+                # — anything we cannot prove dead must not be stolen
+                raise RuntimeError(
+                    f"cannot prove the server on {self.socket_path} is "
+                    f"dead ({e}); refusing to steal its socket")
+            else:
+                raise RuntimeError(
+                    f"another server is live on {self.socket_path}; "
+                    "refusing to steal its socket")
+            finally:
+                probe.close()
         engine = self.engine
 
         class Handler(_Handler):
             pass
 
         Handler.engine = engine
-        self._server = _UnixHTTPServer(self.socket_path, Handler)
+        # the API mutates policy (POST /v1/policy) and enforcement mode:
+        # restrict to the owning user before serving a single request. The
+        # umask makes the socket 0600 AT BIND — a chmod after bind would
+        # leave a window where another user can connect and sit in the
+        # listen backlog until serve_forever picks the connection up
+        old_umask = os.umask(0o177)
+        try:
+            self._server = _UnixHTTPServer(self.socket_path, Handler)
+        finally:
+            os.umask(old_umask)
+        os.chmod(self.socket_path, 0o600)    # belt and braces
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="cilium-tpu-api", daemon=True)
         self._thread.start()
@@ -262,9 +314,17 @@ class _Handler(BaseHTTPRequestHandler):
         eng = self.engine
         path, q = self._route()
         try:
+            if path == "/v1/faults":
+                # exempt from api.handler faults: the chaos driver must be
+                # able to observe/disarm while the fault storm is on
+                return self._send_json(200, FAULTS.stats())
+            FAULTS.fire("api.handler")
             if path == "/v1/healthz":
+                health = eng.health()
                 return self._send_json(200, {
-                    "status": "ok", "revision": eng.repo.revision})
+                    "status": ("ok" if health["state"] == C.HEALTH_OK
+                               else "degraded"),
+                    "revision": eng.repo.revision, **health})
             if path == "/v1/status":
                 return self._send_json(200, status_doc(eng))
             if path == "/v1/endpoints":
@@ -317,6 +377,20 @@ class _Handler(BaseHTTPRequestHandler):
         eng = self.engine
         path, _q = self._route()
         try:
+            if path == "/v1/faults":
+                # chaos driver: arm/disarm injection points in the LIVE
+                # agent ({"spec": "point=mode:..."} / {"disarm": "*"|point})
+                body = self._body()
+                if "disarm" in body:
+                    FAULTS.disarm(None if body["disarm"] in ("*", None)
+                                  else body["disarm"])
+                    return self._send_json(200, {"ok": True})
+                try:
+                    n = FAULTS.load_spec(body.get("spec", ""))
+                except ValueError as e:
+                    return self._send_json(400, {"error": str(e)})
+                return self._send_json(200, {"ok": True, "armed": n})
+            FAULTS.fire("api.handler")
             if path == "/v1/policy":
                 body = self._body()
                 rev = eng.apply_policy(body)
@@ -336,6 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
         eng = self.engine
         path, _q = self._route()
         try:
+            FAULTS.fire("api.handler")
             if path == "/v1/config":
                 body = self._body()
                 # validate the WHOLE request before mutating anything — a

@@ -15,26 +15,109 @@ re-places the CT table — device arrays are a cache of host truth.
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
+import logging
 import os
 import tempfile
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Dict, Optional
+from zipfile import BadZipFile       # np.load raises this on torn archives
 
 import numpy as np
 
 from cilium_tpu.model.services import Backend, Frontend, Service
+from cilium_tpu.runtime.faults import FAULTS
 
 if TYPE_CHECKING:  # Engine pulls in jax; load_host() must stay jax-free
     from cilium_tpu.runtime.engine import Engine
+
+log = logging.getLogger("cilium_tpu.checkpoint")
 
 STATE_FILE = "state.json"
 CT_FILE = "ct.npz"
 FORMAT_VERSION = 1
 
 
+class CheckpointCorrupt(ValueError):
+    """The checkpoint on disk fails validation (torn write, bit rot,
+    unknown version). Callers fall back to cold start instead of serving
+    from — or crashing on — a half-written state dir."""
+
+
+def _state_checksum(state: Dict) -> str:
+    """sha256 over the canonical JSON of everything except the checksum
+    field itself. The body is JSON-round-tripped first so the hash is
+    identical whether computed on the pre-serialization dict (save: int
+    dict keys) or the loaded document (restore: the same keys as strings).
+    """
+    body = json.loads(json.dumps(
+        {k: v for k, v in state.items() if k != "checksum"}, default=str))
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write(dst: str, data, prefix: str) -> None:
+    """tmp-file + fsync + rename + dir-fsync: the destination is either the
+    complete old file or the complete new file, even across power loss.
+
+    ``data`` is either bytes or a callable(fileobj) that streams the payload
+    — the CT archive is tens of MB compressed, so it never sits in RAM.
+    """
+    d = os.path.dirname(dst)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            if callable(data):
+                data(f)
+            else:
+                f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # the injected crash lands in the worst window: tmp fully written,
+        # rename not yet done — the guarantee under test is that dst stays
+        # the complete old file and the tmp is cleaned up
+        FAULTS.fire("checkpoint.write")
+        os.replace(tmp, dst)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    dfd = os.open(d or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def save(engine: Engine, path: str) -> None:
+    """Write an atomic, checksummed checkpoint.
+
+    Order matters: ct.npz first, then state.json carrying ct.npz's sha256.
+    A crash between the two renames leaves the old state.json whose
+    ``ct_sha256`` no longer matches the new ct.npz — restore() then drops
+    the CT (established flows lost, control-plane state intact) instead of
+    pairing mismatched files.
+    """
     os.makedirs(path, exist_ok=True)
+
+    ct_path = os.path.join(path, CT_FILE)
+    _atomic_write(ct_path,
+                  lambda f: np.savez_compressed(f, **engine.ct_arrays()),
+                  ".ct-")
+    ct_sha = _sha256_file(ct_path)
+
     state = {
         "format_version": FORMAT_VERSION,
         "revision": engine.repo.revision,
@@ -68,26 +151,27 @@ def save(engine: Engine, path: str) -> None:
         # DNS cache persists so toFQDNs identities survive a restart
         # (upstream: fqdn cache persistence)
         "dns_cache": engine.ctx.fqdn_cache.export_state(),
+        "ct_sha256": ct_sha,
     }
-    # write-then-rename so a crash never leaves a torn checkpoint
-    fd, tmp = tempfile.mkstemp(dir=path, prefix=".state-")
-    with os.fdopen(fd, "w") as f:
-        json.dump(state, f)
-    os.replace(tmp, os.path.join(path, STATE_FILE))
-
-    ct = engine.ct_arrays()
-    fd, tmp = tempfile.mkstemp(dir=path, prefix=".ct-", suffix=".npz")
-    with os.fdopen(fd, "wb") as f:
-        np.savez_compressed(f, **ct)
-    os.replace(tmp, os.path.join(path, CT_FILE))
+    state["checksum"] = _state_checksum(state)
+    _atomic_write(os.path.join(path, STATE_FILE),
+                  json.dumps(state).encode(), ".state-")
 
 
 def _read_state(path: str) -> Dict:
-    with open(os.path.join(path, STATE_FILE)) as f:
-        state = json.load(f)
+    """Read + validate state.json; raises CheckpointCorrupt on any torn,
+    bit-rotted, or unknown-version state file."""
+    try:
+        with open(os.path.join(path, STATE_FILE)) as f:
+            state = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorrupt(f"unreadable checkpoint state: {e}") from e
     if state.get("format_version") != FORMAT_VERSION:
-        raise ValueError(f"unsupported checkpoint version "
-                         f"{state.get('format_version')}")
+        raise CheckpointCorrupt(f"unsupported checkpoint version "
+                                f"{state.get('format_version')}")
+    # pre-checksum checkpoints (older writers) are accepted as-is
+    if "checksum" in state and state["checksum"] != _state_checksum(state):
+        raise CheckpointCorrupt("checkpoint state checksum mismatch")
     return state
 
 
@@ -136,29 +220,59 @@ def _rebuild_control_plane(state: Dict, ctx, repo,
             ctx.ipcache.upsert(prefix, ident)
 
 
-def _read_ct(path: str) -> Optional[Dict[str, np.ndarray]]:
+def _read_ct(path: str, expected_sha: Optional[str] = None
+             ) -> Optional[Dict[str, np.ndarray]]:
+    """Read ct.npz; a missing, corrupt, or checksum-mismatched CT file
+    degrades to None (established flows lost; control-plane state is
+    unaffected) — CT is a droppable cache, never worth failing a boot."""
     ct_path = os.path.join(path, CT_FILE)
     if not os.path.exists(ct_path):
         return None
-    with np.load(ct_path) as npz:
-        return {k: npz[k] for k in npz.files}
+    try:
+        with open(ct_path, "rb") as f:
+            raw = f.read()
+        if expected_sha is not None \
+                and hashlib.sha256(raw).hexdigest() != expected_sha:
+            log.warning("checkpoint ct.npz checksum mismatch; dropping CT "
+                        "(established flows will re-learn)")
+            return None
+        with np.load(io.BytesIO(raw)) as npz:
+            return {k: npz[k] for k in npz.files}
+    except (OSError, ValueError, BadZipFile) as e:
+        log.warning("checkpoint ct.npz unreadable (%s); dropping CT", e)
+        return None
 
 
-def restore(engine: Engine, path: str) -> None:
-    """Restore host + CT state into a FRESH engine (no endpoints/rules yet)."""
-    state = _read_state(path)
+def restore(engine: Engine, path: str, strict: bool = False) -> bool:
+    """Restore host + CT state into a FRESH engine (no endpoints/rules yet).
+
+    Returns True when the checkpoint was restored. A corrupt checkpoint
+    (torn write, checksum mismatch, unknown version) returns False with the
+    engine untouched — the caller proceeds with a cold start — unless
+    ``strict=True``, which re-raises the CheckpointCorrupt instead.
+    Passing a non-fresh engine is a programming error and always raises.
+    """
     if engine.endpoints or len(engine.repo):
         raise ValueError("restore requires a fresh engine")
+    try:
+        state = _read_state(path)
+    except CheckpointCorrupt as e:
+        if strict:
+            raise
+        log.warning("corrupt checkpoint at %s (%s); falling back to "
+                    "cold start", path, e)
+        return False
     _rebuild_control_plane(
         state, engine.ctx, engine.repo,
         add_endpoint=lambda ep: engine.add_endpoint(
             ep["labels"], ep["ips"], ep_id=ep["ep_id"],
             enforcement=ep.get("enforcement")),
         apply_rules=engine.apply_policy)
-    ct = _read_ct(path)
+    ct = _read_ct(path, state.get("ct_sha256"))
     if ct is not None:
         engine.load_ct_arrays(ct)
     engine.regenerate(force=True)
+    return True
 
 
 # --------------------------------------------------------------------------- #

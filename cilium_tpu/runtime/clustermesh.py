@@ -33,6 +33,7 @@ import time
 from typing import TYPE_CHECKING, Dict, Optional, Tuple
 
 from cilium_tpu.model.labels import Labels
+from cilium_tpu.runtime.faults import FAULTS, FaultInjected
 
 if TYPE_CHECKING:
     from cilium_tpu.runtime.engine import Engine
@@ -58,9 +59,13 @@ class ClusterMesh:
         self._generation = 0
         # peer → {prefix: (identity, labels_key)} we ingested (for release)
         self._ingested: Dict[str, Dict[str, object]] = {}
-        # peer → (doc, last_good_read_ts): a transiently unreadable file
-        # (NFS hiccup) must NOT read as departure — the lease
-        # (stale_after_s), not one failed read, decides withdrawal
+        # peer → (doc, lease_ts): a transiently unreadable file (NFS
+        # hiccup) must NOT read as departure — the lease (stale_after_s),
+        # not one failed read, decides withdrawal. lease_ts is OUR clock,
+        # advanced only when the peer's generation changes: judging
+        # staleness from the peer-written published_at would withdraw a
+        # live peer whose clock is skewed behind ours (etcd leases are
+        # likewise granted on the server's clock, not the client's).
         self._last_good: Dict[str, Tuple[Dict, float]] = {}
         os.makedirs(store_dir, exist_ok=True)
 
@@ -115,9 +120,10 @@ class ClusterMesh:
             seen.add(node)
             path = os.path.join(self.store_dir, name)
             try:
+                FAULTS.fire("clustermesh.peer_read")
                 with open(path) as f:
                     doc = json.load(f)
-            except (OSError, json.JSONDecodeError) as e:
+            except (OSError, json.JSONDecodeError, FaultInjected) as e:
                 log.warning("clustermesh: unreadable peer file %s: %s "
                             "(holding last-known state)", name, e)
                 doc = None
@@ -130,14 +136,20 @@ class ClusterMesh:
                     # identities for the lease duration
                     self._last_good.pop(node, None)
                     continue
-                self._last_good[node] = (doc, now)
-        for node, (doc, _ts) in list(self._last_good.items()):
+                cached = self._last_good.get(node)
+                if (cached is None
+                        or doc.get("generation") != cached[0].get("generation")):
+                    ts = now               # progress observed: renew lease
+                else:
+                    ts = cached[1]         # unchanged generation: lease ages
+                self._last_good[node] = (doc, ts)
+        for node, (doc, ts) in list(self._last_good.items()):
             if listing_ok and node not in seen:
                 # file explicitly gone from a healthy store: the peer's
                 # clean withdraw() — immediate removal (etcd delete analog)
                 del self._last_good[node]
                 continue
-            if now - doc.get("published_at", 0) > self.stale_after_s:
+            if now - ts > self.stale_after_s:
                 del self._last_good[node]
                 continue               # expired lease: treated as withdrawn
             peers[node] = doc
@@ -177,7 +189,18 @@ class ClusterMesh:
                 for prefix, entry in doc.get("entries", {}).items():
                     key = tuple(sorted(entry["labels"]))
                     if prefix in held:
-                        continue       # unchanged (mismatches removed above)
+                        # unchanged claim (label mismatches were removed
+                        # above) — but on a prefix hand-off (pod moved
+                        # between peers) the departing peer's withdrawal
+                        # pass just deleted the ipcache entry out from
+                        # under our still-live claim. Re-upsert when the
+                        # entry is missing (upsert is idempotent) instead
+                        # of short-circuiting into a permanent hole.
+                        ident, _key = held[prefix]
+                        if ctx.ipcache.get(prefix) is None:
+                            ctx.ipcache.upsert(prefix, ident.id)
+                            added += 1
+                        continue
                     ident = ctx.allocator.allocate(Labels.parse(
                         list(entry["labels"])))
                     ctx.ipcache.upsert(prefix, ident.id)

@@ -1,14 +1,40 @@
 """Controllers and triggers (analog of upstream ``pkg/controller`` named
 retry-loops with exponential backoff and ``pkg/trigger`` debounced triggers —
 SURVEY.md §2: "Port pattern — drives incremental tensor updates").
+
+Failure semantics mirror upstream controller runtime: a failing ``do_func``
+never kills the loop; consecutive failures grow a capped exponential backoff
+with deterministic jitter (seeded per controller name, so a chaos run
+replays the exact same schedule), and the counts/last error are exposed in
+``ControllerStatus`` for the health/metrics surfaces.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
+
+
+def backoff_delay(consecutive_failures: int, base: float, cap: float,
+                  rng: Optional[random.Random] = None,
+                  jitter: float = 0.1) -> float:
+    """Capped exponential backoff with proportional jitter.
+
+    ``jitter=0.1`` spreads the delay uniformly over [d, d*1.1] — enough to
+    de-synchronize a fleet of controllers retrying the same failed store
+    without making test schedules unpredictable (pass a seeded rng). The
+    cap is re-applied after jitter: ``cap`` is a hard ceiling, never
+    exceeded.
+    """
+    if consecutive_failures <= 0:
+        return 0.0
+    delay = min(cap, base * (2 ** (consecutive_failures - 1)))
+    if rng is not None and jitter > 0:
+        delay = min(cap, delay * (1.0 + jitter * rng.random()))
+    return delay
 
 
 @dataclass
@@ -19,20 +45,27 @@ class ControllerStatus:
     consecutive_failures: int = 0
     last_error: str = ""
     last_success: float = 0.0
+    last_backoff_s: float = 0.0    # the delay chosen after the last run
 
 
 class Controller:
     """A named reconciliation loop: runs ``do_func`` every ``interval``
-    seconds, retrying with exponential backoff on failure."""
+    seconds, retrying with capped exponential backoff (+ deterministic
+    jitter) on failure."""
 
     def __init__(self, name: str, do_func: Callable[[], None],
                  interval: float, backoff_base: float = 1.0,
-                 backoff_max: float = 60.0):
+                 backoff_max: float = 60.0, jitter: float = 0.1,
+                 seed: Optional[int] = None):
         self.status = ControllerStatus(name)
         self._do = do_func
         self._interval = interval
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
+        self._jitter = jitter
+        # seeded from the name by default: the schedule is stable across
+        # runs (chaos replay) yet differs between controllers (de-sync)
+        self._rng = random.Random(name if seed is None else seed)
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -64,17 +97,35 @@ class Controller:
             self.status.failure_count += 1
             self.status.consecutive_failures += 1
             self.status.last_error = f"{type(e).__name__}: {e}"
+        self.status.last_backoff_s = self._draw_delay()
+
+    def _draw_delay(self) -> float:
+        """Advance the schedule: draw the delay for the run that just
+        finished, consuming one jitter sample from the seeded RNG."""
+        if not self.status.consecutive_failures:
+            return self._interval
+        return backoff_delay(self.status.consecutive_failures,
+                             self._backoff_base, self._backoff_max,
+                             self._rng, self._jitter)
+
+    def next_delay(self) -> float:
+        """Peek at the delay the current failure streak would produce
+        WITHOUT consuming the schedule RNG — observing the schedule (tests,
+        status readers) must not shift the replayable delay sequence."""
+        if not self.status.consecutive_failures:
+            return self._interval
+        state = self._rng.getstate()
+        try:
+            return backoff_delay(self.status.consecutive_failures,
+                                 self._backoff_base, self._backoff_max,
+                                 self._rng, self._jitter)
+        finally:
+            self._rng.setstate(state)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             self.run_once()
-            if self.status.consecutive_failures:
-                delay = min(self._backoff_max,
-                            self._backoff_base
-                            * (2 ** (self.status.consecutive_failures - 1)))
-            else:
-                delay = self._interval
-            self._wake.wait(timeout=delay)
+            self._wake.wait(timeout=self.status.last_backoff_s)
             self._wake.clear()
 
 
@@ -110,35 +161,70 @@ class ControllerManager:
 class Trigger:
     """Debounced trigger (upstream ``pkg/trigger``): many calls within
     ``min_interval`` coalesce into one invocation of ``fn``. ``sync=True``
-    runs inline (deterministic tests)."""
+    runs inline (deterministic tests).
+
+    A failing ``fn`` no longer dies silently in its timer thread: the
+    failure is counted, and in async mode the trigger re-arms itself with
+    capped exponential backoff until ``fn`` succeeds (a crashed regeneration
+    retries instead of waiting for the next external event)."""
 
     def __init__(self, fn: Callable[[], None], min_interval: float = 0.1,
-                 sync: bool = False):
+                 sync: bool = False, backoff_base: float = 0.5,
+                 backoff_max: float = 30.0, max_retries: Optional[int] = 8):
         self._fn = fn
         self._min_interval = min_interval
         self._sync = sync
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self._max_retries = max_retries
+        self._rng = random.Random(0)
         self._lock = threading.Lock()
         self._pending = False
         self._timer: Optional[threading.Timer] = None
-        self.folds = 0     # calls coalesced
+        self.folds = 0                 # calls coalesced
+        self.consecutive_failures = 0
+        self.last_error = ""
 
     def __call__(self) -> None:
         if self._sync:
-            self._fn()
+            try:
+                self._fn()
+            except Exception as e:
+                self.consecutive_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                raise
+            else:
+                self.consecutive_failures = 0
+                self.last_error = ""
             return
+        self._schedule(self._min_interval)
+
+    def _schedule(self, delay: float) -> None:
         with self._lock:
             if self._pending:
                 self.folds += 1
                 return
             self._pending = True
-            self._timer = threading.Timer(self._min_interval, self._fire)
+            self._timer = threading.Timer(delay, self._fire)
             self._timer.daemon = True
             self._timer.start()
 
     def _fire(self) -> None:
         with self._lock:
             self._pending = False
-        self._fn()
+        try:
+            self._fn()
+        except Exception as e:  # noqa: BLE001 — trigger isolates failures
+            self.consecutive_failures += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            if (self._max_retries is None
+                    or self.consecutive_failures <= self._max_retries):
+                self._schedule(backoff_delay(
+                    self.consecutive_failures, self._backoff_base,
+                    self._backoff_max, self._rng))
+        else:
+            self.consecutive_failures = 0
+            self.last_error = ""
 
     def cancel(self) -> None:
         with self._lock:

@@ -38,6 +38,7 @@ from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.runtime.controller import ControllerManager, Trigger
 from cilium_tpu.runtime.datapath import DatapathBackend
+from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.runtime.flowlog import FlowLog
 from cilium_tpu.runtime.metrics import Metrics
 from cilium_tpu.utils import constants as C
@@ -87,6 +88,11 @@ class Engine:
         self._lock = threading.RLock()
         self._active: Optional[CompiledSnapshot] = None
         self._dirty = True
+        # supervised degradation: regen failures never tear down serving —
+        # classify continues on the last-good snapshot while these track
+        # the failure streak for health_probe()/metrics
+        self._regen_failures = 0
+        self._last_regen_error = ""
         self._inc = None           # IncrementalCompiler, seeded on full build
         self._api = None           # APIServer when config.api_socket set
         self._mesh = None          # ClusterMesh when cluster_store set
@@ -179,12 +185,13 @@ class Engine:
             try:
                 self.regenerate()
             except Exception:
-                # controller-style isolation; next classify retries — but
-                # surface it: a silently-failing regen means the device keeps
-                # serving stale policy until the underlying error is fixed.
+                # regenerate() only raises through its supervised
+                # degradation when there is no last-good snapshot (cold
+                # start); it has already counted/logged the failure — keep
+                # the trigger alive and surface that NOTHING is serving
                 logging.getLogger("cilium_tpu.engine").exception(
-                    "regeneration failed; device state is stale")
-                self.metrics.inc_counter("regen_failures_total")
+                    "regeneration failed with no last-good snapshot; "
+                    "nothing is being served")
 
     def regenerate(self, force: bool = False) -> CompiledSnapshot:
         """Compile current control-plane state and swap it in atomically.
@@ -197,69 +204,102 @@ class Engine:
         with self._lock:
             if not (self._dirty or force) and self._active is not None:
                 return self._active
-            eps = sorted(self.endpoints.values(), key=lambda e: e.ep_id)
-            ct_cfg = CTConfig(self.config.ct_capacity,
-                              self.config.probe_depth)
-            lb_cfg = LBConfig(maglev_m=self.config.maglev_m)
-
-            snap = patch = None
-            if (self._inc is not None and self._active is not None
-                    and not force):
-                # NB: lb_cfg is deliberately not passed — LB geometry is
-                # fixed at daemon start; LB content changes gate via
-                # services_revision
-                with self.metrics.span("snapshot_patch").timer():
-                    result = self._inc.try_update(ct_cfg, endpoints=eps)
-                if result is not None:
-                    snap, patch, stats = result
-                    self.metrics.inc_counter("regen_incremental_total")
-                    self.metrics.set_gauge("regen_last_rows_patched",
-                                           stats.rows_recomputed)
-                else:
-                    logging.getLogger("cilium_tpu.engine").debug(
-                        "incremental fallback: %s", self._inc.last_fallback)
-
-            full_build = snap is None
-            if full_build:
-                with self.metrics.span("snapshot_compile").timer():
-                    snap = build_snapshot(self.repo, self.ctx, eps,
-                                          ct_cfg, lb_cfg)
-                self.metrics.inc_counter("regen_full_total")
-
             try:
-                with self.metrics.span("device_place").timer():
-                    if patch is not None and self._active is not None:
-                        if patch.is_noop:
-                            tensors = self._active.tensors
-                        else:
-                            tensors = self.datapath.place_patch(
-                                self._active.tensors, snap, patch)
+                return self._regenerate_locked(force)
+            except Exception as e:  # noqa: BLE001 — supervised degradation
+                self._regen_failures += 1
+                self._last_regen_error = f"{type(e).__name__}: {e}"
+                self.metrics.inc_counter("regen_failures_total")
+                self.metrics.set_gauge("engine_degraded", 1)
+                self.metrics.set_gauge("regen_consecutive_failures",
+                                       self._regen_failures)
+                if self._active is not None:
+                    # serving survives: the last-good snapshot keeps
+                    # answering (verdicts stay bit-identical to the last
+                    # successfully compiled state); _dirty stays set so the
+                    # next classify/trigger retries the compile
+                    logging.getLogger("cilium_tpu.engine").warning(
+                        "regeneration failed (%d consecutive), serving "
+                        "last-good snapshot rev %d: %s",
+                        self._regen_failures, self._active.revision,
+                        self._last_regen_error)
+                    return self._active
+                raise   # cold start: nothing compiled yet, nothing to serve
+
+    def _regenerate_locked(self, force: bool) -> CompiledSnapshot:
+        """The compile+place body of :meth:`regenerate` (lock held)."""
+        FAULTS.fire("regen.compile")
+        eps = sorted(self.endpoints.values(), key=lambda e: e.ep_id)
+        ct_cfg = CTConfig(self.config.ct_capacity,
+                          self.config.probe_depth)
+        lb_cfg = LBConfig(maglev_m=self.config.maglev_m)
+
+        snap = patch = None
+        if (self._inc is not None and self._active is not None
+                and not force):
+            # NB: lb_cfg is deliberately not passed — LB geometry is
+            # fixed at daemon start; LB content changes gate via
+            # services_revision
+            with self.metrics.span("snapshot_patch").timer():
+                result = self._inc.try_update(ct_cfg, endpoints=eps)
+            if result is not None:
+                snap, patch, stats = result
+                self.metrics.inc_counter("regen_incremental_total")
+                self.metrics.set_gauge("regen_last_rows_patched",
+                                       stats.rows_recomputed)
+            else:
+                logging.getLogger("cilium_tpu.engine").debug(
+                    "incremental fallback: %s", self._inc.last_fallback)
+
+        full_build = snap is None
+        if full_build:
+            with self.metrics.span("snapshot_compile").timer():
+                snap = build_snapshot(self.repo, self.ctx, eps,
+                                      ct_cfg, lb_cfg)
+            self.metrics.inc_counter("regen_full_total")
+
+        try:
+            with self.metrics.span("device_place").timer():
+                if patch is not None and self._active is not None:
+                    if patch.is_noop:
+                        tensors = self._active.tensors
                     else:
-                        tensors = self.datapath.place(snap)
-            except Exception:
-                # the incremental compiler already advanced past this
-                # revision; keeping it would let a retry pair the new
-                # snapshot with never-patched device tensors (silent stale
-                # policy). Discard — the retry takes the full-build path.
-                self._inc = None
-                raise
-            if full_build and self.config.incremental:
-                # seed only after placement succeeded (same staleness trap)
-                from cilium_tpu.compile.incremental import \
-                    IncrementalCompiler
-                self._inc = IncrementalCompiler(self.repo, self.ctx,
-                                                eps, snap)
-            self.repo.prune_changes(snap.revision)
-            compiled = CompiledSnapshot(
-                snapshot=snap, tensors=tensors,
-                world_index=snap.world_index, revision=snap.revision)
-            self._active = compiled            # atomic swap (revision fence)
-            self._dirty = False
-            for ep in self.endpoints.values():
-                ep.policy_revision = snap.revision
-            self.metrics.set_gauge("policy_revision", snap.revision)
-            self.metrics.set_gauge("policy_image_bytes", snap.nbytes)
-            return compiled
+                        tensors = self.datapath.place_patch(
+                            self._active.tensors, snap, patch)
+                else:
+                    tensors = self.datapath.place(snap)
+        except Exception:
+            # the incremental compiler already advanced past this
+            # revision; keeping it would let a retry pair the new
+            # snapshot with never-patched device tensors (silent stale
+            # policy). Discard — the retry takes the full-build path.
+            self._inc = None
+            raise
+        if full_build and self.config.incremental:
+            # seed only after placement succeeded (same staleness trap)
+            from cilium_tpu.compile.incremental import \
+                IncrementalCompiler
+            self._inc = IncrementalCompiler(self.repo, self.ctx,
+                                            eps, snap)
+        self.repo.prune_changes(snap.revision)
+        compiled = CompiledSnapshot(
+            snapshot=snap, tensors=tensors,
+            world_index=snap.world_index, revision=snap.revision)
+        self._active = compiled            # atomic swap (revision fence)
+        self._dirty = False
+        if self._regen_failures:
+            logging.getLogger("cilium_tpu.engine").info(
+                "regeneration recovered after %d failures (rev %d)",
+                self._regen_failures, snap.revision)
+        self._regen_failures = 0
+        self._last_regen_error = ""
+        for ep in self.endpoints.values():
+            ep.policy_revision = snap.revision
+        self.metrics.set_gauge("policy_revision", snap.revision)
+        self.metrics.set_gauge("policy_image_bytes", snap.nbytes)
+        self.metrics.set_gauge("engine_degraded", 0)
+        self.metrics.set_gauge("regen_consecutive_failures", 0)
+        return compiled
 
     @property
     def active(self) -> CompiledSnapshot:
@@ -321,11 +361,38 @@ class Engine:
                 "obs-flush", self.flush_observability,
                 interval=self.config.obs_flush_interval_s)
 
-    def health_probe(self, now: Optional[int] = None) -> Dict[int, Dict]:
+    def health(self) -> Dict:
+        """Engine health summary (the supervised-degradation surface).
+
+        States:
+          OK        — the active snapshot is the current compiled state
+          DEGRADED  — regeneration is failing; serving the last-good
+                      snapshot, which is still semantically current
+          STALE     — regeneration is failing AND committed policy changes
+                      (repo revision > active revision) cannot be compiled:
+                      verdicts are correct for an older policy world
+        """
+        with self._lock:
+            active = self._active
+            state = C.HEALTH_OK
+            if self._regen_failures:
+                state = C.HEALTH_DEGRADED
+                if active is not None and self.repo.revision > active.revision:
+                    state = C.HEALTH_STALE
+            return {
+                "state": state,
+                "consecutive_regen_failures": self._regen_failures,
+                "last_regen_error": self._last_regen_error,
+                "active_revision": active.revision if active else None,
+                "repo_revision": self.repo.revision,
+            }
+
+    def health_probe(self, now: Optional[int] = None) -> Dict:
         """Datapath health check (cilium-health analog): classify one ICMP
         echo probe from the reserved health identity to every endpoint with
         an IP, through the real device path. Returns
-        {ep_id: {reachable, reason, ct_state}}; a probe's verdict follows
+        {ep_id: {reachable, reason, ct_state}, "engine": health()}; a
+        probe's verdict follows
         policy exactly like real traffic (an endpoint whose ingress denies
         the health identity reports unreachable — same as upstream when
         health checks are not whitelisted)."""
@@ -339,7 +406,7 @@ class Engine:
         eps = [ep for ep in sorted(self.endpoints.values(),
                                    key=lambda e: e.ep_id) if ep.ips]
         if not eps:
-            return {}
+            return {"engine": self.health()}
         recs = []
         for ep in eps:
             dst16, v6 = parse_addr(ep.ips[0])
@@ -360,6 +427,7 @@ class Engine:
         self.metrics.set_gauge(
             "health_reachable_endpoints",
             sum(1 for r in report.values() if r["reachable"]))
+        report["engine"] = self.health()
         return report
 
     def profile_classify(self, batch: Dict[str, np.ndarray], trace_dir: str,

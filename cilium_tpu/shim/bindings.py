@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
 
 _SHIM_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -172,6 +173,7 @@ class FlowShim:
                    ) -> Optional[Dict[str, np.ndarray]]:
         """Harvest a batch in the kernels/records layout (None if not ready).
         Records for unknown endpoints (ep_id 0) stay invalid (fail closed)."""
+        FAULTS.fire("shim.rx_ring")
         n = self._lib.shim_poll_batch(self._handle, now_us, int(force),
                                       self._rec_buf, self._tok_buf)
         if n == 0:
@@ -260,7 +262,12 @@ class FlowShim:
     # -- ring path (kernel-mapped after afxdp_bind; heap-mocked for tests) --
     def afxdp_poll(self, budget: int = 256, now_us: int = 0) -> int:
         """Drain the rx ring into the batcher (completion→fill recycle
-        first). Returns descriptors drained, or -errno."""
+        first). Returns descriptors drained, or -errno.
+
+        The ``shim.rx_ring`` injection point fires here: a fault is one
+        failed poll (the caller's harvest loop must tolerate it — frames
+        stay queued in the ring and drain on the next poll)."""
+        FAULTS.fire("shim.rx_ring")
         return self._lib.shim_afxdp_poll(self._handle, budget, now_us)
 
     def mock_rings_init(self, ring_size: int = 64, frame_size: int = 2048,

@@ -187,6 +187,15 @@ ENFORCEMENT_MODES = (ENFORCEMENT_DEFAULT, ENFORCEMENT_ALWAYS, ENFORCEMENT_NEVER)
 HEALTH_PROBE_IP = "169.254.254.254"
 ICMP_ECHO_REQUEST = 8
 
+# Engine health states (supervised degradation — runtime/engine.health()):
+# OK = serving the current compiled snapshot; DEGRADED = regeneration
+# failing, serving the last-good snapshot (still semantically current);
+# STALE = regeneration failing with committed policy changes pending.
+HEALTH_OK = "OK"
+HEALTH_DEGRADED = "DEGRADED"
+HEALTH_STALE = "STALE"
+HEALTH_STATES = (HEALTH_OK, HEALTH_DEGRADED, HEALTH_STALE)
+
 # --------------------------------------------------------------------------- #
 # L7-lite (config 4): tokenized HTTP method/path-prefix matching
 # --------------------------------------------------------------------------- #

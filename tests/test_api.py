@@ -123,7 +123,8 @@ class TestAPIServer:
         client = UnixAPIClient(sock)
         code, doc = client.get("/v1/health")
         assert code == 200
-        assert set(doc) == {"1", "3"} or set(doc) == {1, 3}
+        assert set(doc) == {"1", "3", "engine"} or set(doc) == {1, 3, "engine"}
+        assert doc["engine"]["state"] == C.HEALTH_OK
 
     def test_stale_socket_is_replaced(self, live_engine, tmp_path):
         eng, sock = live_engine

@@ -71,7 +71,7 @@ class TestClusterMesh:
         assert ident is not None
         assert "k8s:role=backup" in ident.labels.to_strings()
 
-    def test_withdrawal_and_stale_peer(self, tmp_path):
+    def test_withdrawal_and_stale_peer(self, tmp_path, monkeypatch):
         a = _node(tmp_path, "node-a")
         b = _node(tmp_path, "node-b")
         a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.5",), ep_id=1)
@@ -88,16 +88,19 @@ class TestClusterMesh:
         mesh_b.sync()
         assert "10.1.0.5/32" not in b.ctx.ipcache.snapshot()
 
-        # stale peer file (lease expiry): state withdrawn even with no
-        # explicit removal
+        # stale peer (lease expiry): state withdrawn even with no explicit
+        # removal. Staleness is judged from B's OWN lease clock, renewed
+        # only on generation progress (never from the peer-written
+        # published_at, which a skewed peer clock would poison) — so the
+        # stall is simulated by freezing A's generation and advancing B's
+        # clock past the lease.
         a.add_endpoint(["k8s:role=backup"], ips=("10.1.0.6",), ep_id=2)
         mesh_a.publish()
         mesh_b.sync()
         assert "10.1.0.6/32" in b.ctx.ipcache.snapshot()
-        path = tmp_path / "store" / "node-a.json"
-        doc = json.loads(path.read_text())
-        doc["published_at"] = time.time() - 3600
-        path.write_text(json.dumps(doc))
+        import cilium_tpu.runtime.clustermesh as cm
+        real_time = time.time
+        monkeypatch.setattr(cm.time, "time", lambda: real_time() + 3600)
         mesh_b.sync()
         assert "10.1.0.6/32" not in b.ctx.ipcache.snapshot()
 

@@ -17,7 +17,10 @@ import struct
 import subprocess
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property fuzzing needs the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from cilium_tpu.model.labels import Labels
 from cilium_tpu.model.rules import RuleParseError, parse_rule

@@ -370,20 +370,26 @@ class TestFlowLogSinkCap:
 class TestRegenFailureVisibility:
     def test_regen_failure_logged_and_counted(self, caplog):
         """A failing auto-regen must not be silent: it logs and bumps
-        regen_failures_total so operators see stale device state."""
+        regen_failures_total exactly once so operators see stale device
+        state (supervised degradation: serving continues on last-good)."""
         import logging as _logging
+
+        from cilium_tpu.runtime.faults import FAULTS
         eng = small_engine(auto_regen=True)
         eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
         eng.apply_policy(POLICY)
-
-        def boom(*a, **k):
-            raise RuntimeError("compile exploded")
-
-        eng.regenerate = boom
-        with caplog.at_level(_logging.ERROR, logger="cilium_tpu.engine"):
-            eng._mark_dirty_and_regen()
+        _ = eng.active                             # last-good exists
+        eng._regen_trigger.cancel()                # no async timer racing us
+        try:
+            FAULTS.arm("regen.compile", mode="fail", times=1)
+            with caplog.at_level(_logging.WARNING,
+                                 logger="cilium_tpu.engine"):
+                eng._mark_dirty_and_regen()
+        finally:
+            FAULTS.reset()
         assert eng.metrics.counters.get("regen_failures_total") == 1
-        assert any("regeneration failed" in r.message for r in caplog.records)
+        assert any("regeneration failed" in r.message
+                   for r in caplog.records)
         assert "regen_failures_total 1" in eng.metrics.render_prometheus()
 
 
