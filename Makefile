@@ -8,7 +8,7 @@
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast chaos shim bench clean
+.PHONY: test test-fast chaos pipeline-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -25,6 +25,15 @@ test-fast:
 chaos:
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
+
+# Ingestion-pipeline gate (pipeline/scheduler.py): the tier-1 pipeline
+# subset (ordering, backpressure, deadline flush, fault retries, clean
+# shutdown, serial-vs-pipelined verdict parity) plus the slow-marked
+# FakeDatapath soak — 10k submissions with `pipeline.dispatch` faults
+# armed, asserting no queued batch is lost or reordered.
+pipeline-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m slow
 
 shim:
 	$(MAKE) -C cilium_tpu/shim

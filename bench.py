@@ -709,6 +709,129 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     }
 
 
+def pipeline_bench(config: int, preset: str, batch: int, batches: int,
+                   windows: int = 3, verbose: bool = False):
+    """Serial vs pipelined ingestion on one config, through the real
+    ``DatapathBackend`` boundary (JITDatapath behind the Pipeline
+    scheduler), over the same ingest stream: the shim's rx polls deliver
+    sub-full chunks (``batch // 8`` records — an AF_XDP poll budget), and
+
+    - **serial** classifies each chunk as it arrives with a blocking wait
+      (today's per-poll serving path: build → transfer → classify →
+      verdict fence, strictly sequential);
+    - **pipelined** submits the same chunks to the scheduler, which
+      coalesces them into full ``batch``-row buckets and keeps
+      ``pipeline_inflight`` dispatches in flight via ``classify_async`` —
+      host staging/transfer overlapped with the previous bucket's device
+      compute, one device shape, 8x fewer dispatches.
+
+    Same flows, same CT geometry, same kernel — the delta is scheduling.
+    """
+    from cilium_tpu.pipeline import Pipeline
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.metrics import Metrics
+
+    t0 = time.time()
+    snap, gen, v4_only = BUILDERS[config](preset)
+    compile_s = time.time() - t0
+    cfg = DaemonConfig(ct_capacity=snap.ct_config.capacity,
+                       probe_depth=snap.ct_config.probe_depth,
+                       v4_only=v4_only, batch_size=batch)
+    dp = JITDatapath(cfg)
+    placed = dp.place(snap)
+    rng = np.random.default_rng(7)
+    chunk = max(64, batch // 8)
+    chunks = []
+    for _ in range(min(batches, 8)):
+        full = gen(rng, batch)
+        chunks.extend({k: v[j:j + chunk] for k, v in full.items()}
+                      for j in range(0, batch, chunk))
+    now = [20_000]
+
+    # warmup both device shapes (chunk for serial, full bucket for pipelined)
+    dp.classify(placed, snap, dict(chunks[0]), now[0])
+    dp.classify(placed, snap, gen(rng, batch), now[0])
+
+    def serial_pass():
+        for i in range(batches * (batch // chunk)):
+            now[0] += 1
+            dp.classify(placed, snap, chunks[i % len(chunks)], now[0])
+
+    def make_pipeline(met):
+        def dispatch_fn(b, n):
+            fin = dp.classify_async(placed, snap, b, n)
+            return lambda: fin()[0]
+        # min_bucket == batch: every coalesced dispatch is the one
+        # device-optimal shape (no trace proliferation)
+        return Pipeline(dispatch_fn, metrics=met, max_bucket=batch,
+                        min_bucket=batch,
+                        queue_batches=max(64, cfg.pipeline_queue_batches),
+                        admission="block", block_timeout_s=60.0,
+                        flush_ms=cfg.pipeline_flush_ms,
+                        inflight=cfg.pipeline_inflight)
+
+    met = Metrics()
+    pl = make_pipeline(met)        # long-lived, like a serving daemon's
+
+    def pipe_pass():
+        for i in range(batches * (batch // chunk)):
+            now[0] += 1
+            pl.submit(chunks[i % len(chunks)], now=now[0])
+        assert pl.drain(timeout=600), "pipeline drain timed out"
+
+    serial_pass()                   # calibrate both modes on a warm link
+    pipe_pass()
+    serial_tp, pipe_tp = [], []
+    for _w in range(windows):
+        # alternate which mode runs first so CT-occupancy / link drift
+        # across the run cannot systematically favor one mode
+        order = ((serial_pass, serial_tp), (pipe_pass, pipe_tp))
+        if _w % 2:
+            order = order[::-1]
+        for fn, acc in order:
+            t1 = time.time()
+            fn()
+            acc.append(batches * batch / (time.time() - t1))
+
+    def _med(vals):
+        return float(np.percentile(np.asarray(vals, np.float64), 50))
+
+    serial_med, pipe_med = _med(serial_tp), _med(pipe_tp)
+    qw = met.histograms.get("pipeline_queue_wait_seconds")
+    bl = met.histograms.get("pipeline_batch_latency_seconds")
+    stats = pl.stats()
+    pl.close(timeout=30)
+    if verbose:
+        print(f"# pipeline bench config={config} preset={preset} "
+              f"batch={batch} batches={batches} compile={compile_s:.1f}s\n"
+              f"# serial windows (Mfl/s): "
+              f"{[round(x / 1e6, 1) for x in serial_tp]}\n"
+              f"# pipelined windows (Mfl/s): "
+              f"{[round(x / 1e6, 1) for x in pipe_tp]}", file=sys.stderr)
+    return {
+        "metric": f"pipeline_ingestion_{METRIC_NAMES[config]}",
+        "value": round(pipe_med, 1),
+        "unit": "flows/sec",
+        "vs_baseline": round(pipe_med / PER_CHIP_TARGET, 4),
+        "serial_flows_per_sec": round(serial_med, 1),
+        "pipelined_flows_per_sec": round(pipe_med, 1),
+        "speedup_vs_serial": round(pipe_med / max(serial_med, 1e-9), 3),
+        "queue_wait_p50_ms": round(qw.quantile(0.5) * 1e3, 3) if qw else 0.0,
+        "queue_wait_p99_ms": round(qw.quantile(0.99) * 1e3, 3) if qw else 0.0,
+        "batch_latency_p50_ms": round(bl.quantile(0.5) * 1e3, 3)
+        if bl else 0.0,
+        "fill_ratio": stats["fill_ratio_avg"],
+        "flush_reasons": stats["flush_reasons"],
+        "inflight": cfg.pipeline_inflight,
+        "ingest_chunk": chunk,
+        "windows": windows,
+        "batch": batch,
+        "batches": batches,
+        "preset": preset,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=5, choices=sorted(BUILDERS))
@@ -719,6 +842,10 @@ def main(argv=None):
     ap.add_argument("--only", action="store_true",
                     help="run just --config (default: all five, with "
                          "--config as the headline metric)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="pipelined-ingestion mode: serial vs overlapped "
+                         "(pipeline/scheduler.py) throughput on --config, "
+                         "one JSON line with queue-wait and fill-ratio")
     ap.add_argument("--shards", type=int, default=1,
                     help="flow shards (data-parallel mesh axis); >1 routes "
                          "through the production multi-chip path")
@@ -756,6 +883,13 @@ def main(argv=None):
     batches = args.batches or (10 if preset == "smoke" else 40)
 
     _start_watchdog(METRIC_NAMES[args.config])
+    if args.pipeline:
+        result = pipeline_bench(args.config, preset, batch, batches,
+                                windows=max(3, args.windows - 2),
+                                verbose=args.verbose)
+        _progress["headline"] = result
+        print(json.dumps(result))
+        return
     result = run_bench(args.config, preset, batch, batches,
                        verbose=args.verbose, windows=args.windows,
                        shards=args.shards, rule_shards=args.rule_shards,

@@ -246,6 +246,23 @@ def _cmd_status(args) -> int:
             print(f"Conntrack:        {d['conntrack']['live']}/"
                   f"{d['conntrack']['capacity']} live")
         print(f"Enforcement:      {d['enforcement_mode']}")
+        pl = d.get("pipeline")
+        if pl:
+            fl = pl.get("flush_reasons", {})
+            print("Pipeline:")
+            print(f"  queue depth:    {pl.get('queue_depth')}"
+                  f" (inflight {pl.get('inflight')},"
+                  f" staged rows {pl.get('staged_rows')})")
+            print(f"  dispatched:     {pl.get('dispatched_batches')} batches"
+                  f" ({pl.get('submitted')} submissions, fill"
+                  f" {pl.get('fill_ratio_avg')})")
+            print(f"  flush reasons:  "
+                  + " ".join(f"{k}={v}" for k, v in sorted(fl.items())))
+            print(f"  queue wait:     p50={pl.get('queue_wait_p50_ms')}ms"
+                  f" p99={pl.get('queue_wait_p99_ms')}ms")
+            print(f"  drops/faults:   {pl.get('admission_drops')} admission,"
+                  f" {pl.get('dispatch_faults')} dispatch faults,"
+                  f" {pl.get('dispatch_errors')} errors")
 
     if args.api:
         return _live_emit(args, "GET", "/v1/status", text_fn=text)
@@ -843,6 +860,34 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
             f"converged={present1}")
     finally:
         shutil.rmtree(store, ignore_errors=True)
+
+    # -- phase 3.5: pipeline dispatch storm ---------------------------------
+    # pipelined ingestion under a 50% dispatch-fault storm: every submission
+    # must still resolve, in order, with verdicts bit-identical to the
+    # serial baseline (the scheduler retries trips — delay, never drop)
+    FAULTS.arm("pipeline.dispatch", mode="prob", prob=0.5, seed=seed)
+    n_sub = 24
+    tickets = [eng.submit(mk_batch(slot_of), now=300 + i)
+               for i in range(n_sub)]
+    drained = eng.drain(timeout=60)
+    pl_errors = pl_divergences = 0
+    for t in tickets:
+        try:
+            out = t.result(timeout=5)
+        except Exception:
+            pl_errors += 1
+            continue
+        if [bool(a) for a in out["allow"]] != baseline:
+            pl_divergences += 1
+    FAULTS.disarm("pipeline.dispatch")
+    pstats = eng.pipeline_stats() or {}
+    report.record(
+        "pipeline-storm",
+        drained and pl_errors == 0 and pl_divergences == 0
+        and pstats.get("dispatch_faults", 0) > 0,
+        f"{n_sub} pipelined submissions at 50% dispatch faults: "
+        f"{pstats.get('dispatch_faults', 0)} trips retried, {pl_errors} "
+        f"errors, {pl_divergences} verdict divergences, drained={drained}")
 
     # -- phase 4: checkpoint torn write + corruption fallback ---------------
     state = tempfile.mkdtemp(prefix="cilium-tpu-chaos-ckpt-")

@@ -24,7 +24,7 @@ from cilium_tpu.kernels.lpm import lpm_lookup_batch
 from cilium_tpu.kernels.policy import policy_lookup_batch
 from cilium_tpu.utils import constants as C
 
-N_REASON_BINS = 256
+N_REASON_BINS = C.DROP_REASON_BINS   # counter-tensor geometry (one source)
 
 
 def classify_step(tensors, ct, batch, now, world_index=0, *,
@@ -40,7 +40,8 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     rewrite columns the shim applies: svc [N] bool, nat_dst [N,4] uint32,
     nat_dport [N] int32 (forward DNAT) and rnat [N] bool, rnat_src [N,4]
     uint32, rnat_sport [N] int32 (reply un-DNAT).
-    counters: by_reason_dir [512] uint32, insert_fail uint32 scalar.
+    counters: by_reason_dir [COUNTER_CELLS] uint32 (reasons x directions),
+    insert_fail uint32 scalar.
     """
     valid = batch["valid"]
     direction = batch["direction"]

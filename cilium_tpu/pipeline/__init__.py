@@ -1,0 +1,11 @@
+"""Pipelined ingestion scheduler: overlapped host→device batching runtime.
+
+See :mod:`cilium_tpu.pipeline.scheduler` for the design.
+"""
+
+from cilium_tpu.pipeline.scheduler import (Pipeline, PipelineClosed,
+                                           PipelineDrop, PipelineError,
+                                           Ticket)
+
+__all__ = ["Pipeline", "PipelineClosed", "PipelineDrop", "PipelineError",
+           "Ticket"]

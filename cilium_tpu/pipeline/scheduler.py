@@ -1,0 +1,592 @@
+"""Pipelined ingestion scheduler: overlapped host→device batching runtime.
+
+BENCH_r05 showed the serving path ~30-60x off its own compute ceiling:
+``compute_only`` runs ~300M flows/sec/chip while the end-to-end path sits at
+6-9M, because a batch is built, transferred, and classified strictly
+serially. This subsystem is the continuous-batching layer between the shim
+and the datapath that closes that shape problem:
+
+- **Admission with backpressure** (``submit``): a bounded multi-producer
+  queue. When full, producers either block up to a timeout or shed
+  immediately (``admission="drop"``) — never unbounded blocking, and every
+  shed submission is accounted (``pipeline_admission_drops_total``).
+- **Deadline-based microbatching**: sub-full submissions coalesce in a host
+  staging buffer until either the buffer fills or the oldest submission's
+  deadline (``flush_ms``) expires. Dispatch shapes are drawn from a small
+  set of power-of-two buckets in ``[min_bucket, max_bucket]`` so the device
+  sees a handful of stable shapes (no recompile storms). A submission that
+  already *is* a bucket shape bypasses staging entirely (zero-copy
+  ``direct`` dispatch).
+- **Overlap** (double/ring-buffered staging): dispatch goes through
+  ``DatapathBackend.classify_async`` — the JIT backend enqueues pack +
+  transfer + XLA dispatch and returns a finalize callable, so the worker
+  stages and transfers batch *i+1* while the device still computes batch
+  *i* (up to ``inflight`` batches in flight; CT buffer donation sequences
+  the steps on-device). On FakeDatapath classify_async is synchronous — a
+  plain queue, same semantics, no overlap.
+- **Ordering**: one worker drains the queue FIFO and finalizes in-flight
+  batches FIFO, so CT mutation order == submission order and every ticket
+  resolves in order. This is what makes pipeline verdicts bit-identical to
+  the serial ``classify`` path on the same submissions.
+- **Telemetry**: queue depth / inflight gauges, admission drops, flush
+  reasons, fill ratio, and ``pipeline_queue_wait_seconds`` /
+  ``pipeline_batch_latency_seconds`` histograms through ``Metrics``.
+
+Fault injection: every dispatch fires the ``pipeline.dispatch`` point.
+``FaultInjected`` trips are retried with a capped backoff (counted in
+``pipeline_dispatch_faults_total``) — an armed chaos scenario delays
+batches but never loses or reorders them. Non-fault dispatch errors reject
+only the affected tickets; the pipeline keeps serving (supervised
+degradation, same philosophy as the engine's regen path).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.runtime.faults import FAULTS, FaultInjected
+from cilium_tpu.runtime.metrics import Metrics
+
+log = logging.getLogger("cilium_tpu.pipeline")
+
+#: retry caps for FaultInjected dispatch trips (the closing cap bounds
+#: shutdown time when a fail-always fault is armed)
+MAX_DISPATCH_RETRIES = 1000
+MAX_DISPATCH_RETRIES_CLOSING = 25
+
+# canonical out columns (the DatapathBackend.classify contract) — used to
+# resolve all-invalid submissions without a device round trip
+_OUT_SPEC: Tuple[Tuple[str, type, Tuple[int, ...]], ...] = (
+    ("allow", bool, ()), ("reason", np.int32, ()), ("status", np.int32, ()),
+    ("remote_identity", np.int32, ()), ("redirect", bool, ()),
+    ("svc", bool, ()), ("nat_dst", np.uint32, (4,)),
+    ("nat_dport", np.int32, ()), ("rnat", bool, ()),
+    ("rnat_src", np.uint32, (4,)), ("rnat_sport", np.int32, ()),
+)
+
+
+def _zero_out(n: int) -> Dict[str, np.ndarray]:
+    return {k: np.zeros((n,) + shape, dtype=dt) for k, dt, shape in _OUT_SPEC}
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class PipelineError(RuntimeError):
+    """Base error for pipeline submissions."""
+
+
+class PipelineDrop(PipelineError):
+    """Submission shed at admission (queue full, drop mode or block
+    timeout exhausted)."""
+
+
+class PipelineClosed(PipelineError):
+    """submit() after close()."""
+
+
+class Ticket:
+    """Handle for one submission. ``result()`` blocks until the pipeline
+    resolved this submission's rows and returns the out dict (same row
+    geometry as the submitted batch; invalid rows zero-filled, exactly like
+    the serial classify path)."""
+
+    __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono",
+                 "_event", "_out", "_exc")
+
+    def __init__(self, n_rows: int, n_valid: int):
+        self.seq = -1                      # assigned at admission
+        self.n_rows = n_rows
+        self.n_valid = n_valid
+        self.submitted_mono = time.monotonic()
+        self._event = threading.Event()
+        self._out: Optional[Dict[str, np.ndarray]] = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def dropped(self) -> bool:
+        return isinstance(self._exc, PipelineDrop)
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"pipeline ticket seq={self.seq} not resolved "
+                               f"within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._out
+
+    # -- pipeline-internal ---------------------------------------------------
+    def _resolve(self, out: Dict[str, np.ndarray]) -> None:
+        self._out = out
+        self._event.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+
+class _Sub:
+    """One admitted submission riding the queue. ``valid_idx`` is computed
+    lazily on the worker — the direct-dispatch fast path never needs it."""
+
+    __slots__ = ("ticket", "batch", "now")
+
+    def __init__(self, ticket: Ticket, batch: Dict[str, np.ndarray],
+                 now: Optional[int]):
+        self.ticket = ticket
+        self.batch = batch
+        self.now = now
+
+
+class _Slice:
+    """A submission's rows inside one dispatched bucket. ``valid_idx`` is
+    None for a direct (zero-copy) dispatch: the out arrays already have the
+    submission's row geometry."""
+
+    __slots__ = ("ticket", "valid_idx", "dst_start")
+
+    def __init__(self, ticket: Ticket, valid_idx: Optional[np.ndarray],
+                 dst_start: int):
+        self.ticket = ticket
+        self.valid_idx = valid_idx
+        self.dst_start = dst_start
+
+
+class _Inflight:
+    __slots__ = ("finalize", "slices", "t_dispatch", "buf_idx")
+
+    def __init__(self, finalize, slices, t_dispatch, buf_idx):
+        self.finalize = finalize
+        self.slices = slices
+        self.t_dispatch = t_dispatch
+        self.buf_idx = buf_idx
+
+
+class Pipeline:
+    """The scheduler. ``dispatch_fn(batch, now)`` must enqueue one batch and
+    return a zero-arg finalize callable yielding the out dict — the Engine
+    provides a closure over ``DatapathBackend.classify_async`` that also
+    feeds metrics and the flow log.
+
+    Producers call :meth:`submit` from any thread; one worker thread owns
+    staging, dispatch, and finalization, which is what guarantees CT-order
+    == submission-order."""
+
+    def __init__(self, dispatch_fn: Callable, *,
+                 metrics: Optional[Metrics] = None,
+                 max_bucket: int = 8192, min_bucket: int = 256,
+                 queue_batches: int = 64, admission: str = "block",
+                 block_timeout_s: float = 1.0, flush_ms: float = 2.0,
+                 inflight: int = 2, name: str = "pipeline"):
+        if max_bucket & (max_bucket - 1) or max_bucket <= 0:
+            raise ValueError("max_bucket must be a power of two")
+        if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
+            raise ValueError("min_bucket must be a power of two "
+                             "<= max_bucket")
+        if admission not in ("block", "drop"):
+            raise ValueError(f"bad admission mode {admission!r}")
+        if inflight < 1 or queue_batches < 1:
+            raise ValueError("inflight and queue_batches must be >= 1")
+        self._dispatch_fn = dispatch_fn
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._max_bucket = max_bucket
+        self._min_bucket = min_bucket
+        self._queue_max = queue_batches
+        self._admission = admission
+        self._block_timeout_s = block_timeout_s
+        self._flush_s = flush_ms / 1e3
+        self._inflight_max = inflight
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._outstanding = 0            # accepted tickets not yet resolved
+        self._drain_req = 0
+        self._closing = False
+        self._closed = False
+        self._next_seq = 0
+
+        # worker-owned (no lock): staging ring + inflight window
+        self._buffers = [empty_batch(max_bucket)
+                         for _ in range(inflight + 1)]
+        self._free_bufs: List[int] = list(range(len(self._buffers)))
+        self._stage_buf: Optional[int] = None
+        self._staged_rows = 0
+        self._staged_slices: List[_Slice] = []
+        self._stage_deadline = 0.0
+        self._stage_now: Optional[int] = None
+        self._inflight: deque = deque()
+        self._current: Optional[_Sub] = None   # popped, mid-_ingest
+
+        # stats (worker-owned except drops/submitted)
+        self.submitted = 0
+        self.admission_drops = 0
+        self.dispatched_batches = 0
+        self.completed_batches = 0
+        self.dispatch_faults = 0
+        self.dispatch_errors = 0
+        self.flush_reasons: Dict[str, int] = {
+            "direct": 0, "full": 0, "deadline": 0, "drain": 0}
+        self._fill_rows = 0
+        self._bucket_rows = 0
+
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-worker")
+        self._worker.start()
+
+    # -- producer side -------------------------------------------------------
+    def submit(self, batch: Dict[str, np.ndarray],
+               now: Optional[int] = None,
+               timeout: Optional[float] = None) -> Ticket:
+        """Admit one batch (records layout, ``valid``-masked). Returns a
+        :class:`Ticket` immediately; with ``admission="drop"`` (or a blocked
+        admission that times out) the ticket comes back already rejected
+        with :class:`PipelineDrop` — check ``ticket.dropped``.
+
+        The caller must not mutate ``batch`` until the ticket resolves (the
+        staging copy happens on the worker; a direct-dispatch batch is read
+        by the flow log at finalize time)."""
+        valid = np.asarray(batch["valid"])
+        n_valid = int(valid.sum())
+        if n_valid > self._max_bucket:
+            raise ValueError(
+                f"submission has {n_valid} valid rows > max_bucket "
+                f"{self._max_bucket}; split it or raise batch_size")
+        ticket = Ticket(n_rows=int(valid.shape[0]), n_valid=n_valid)
+        deadline = time.monotonic() + (
+            self._block_timeout_s if timeout is None else timeout)
+        with self._lock:
+            if self._closing or self._closed:
+                raise PipelineClosed("pipeline is closed")
+            while len(self._queue) >= self._queue_max:
+                remaining = deadline - time.monotonic()
+                if self._admission == "drop" or remaining <= 0:
+                    self.admission_drops += 1
+                    self.metrics.inc_counter("pipeline_admission_drops_total")
+                    ticket._reject(PipelineDrop(
+                        f"queue full ({self._queue_max} batches); "
+                        f"admission={self._admission}"))
+                    return ticket
+                self._cond.wait(min(remaining, 0.05))
+                if self._closing or self._closed:
+                    raise PipelineClosed("pipeline closed while blocked "
+                                         "at admission")
+            ticket.seq = self._next_seq
+            self._next_seq += 1
+            self._queue.append(_Sub(ticket, batch, now))
+            self.submitted += 1
+            self._outstanding += 1
+            self.metrics.set_gauge("pipeline_queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return ticket
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted submission so far has resolved
+        (flushes any staged microbatch immediately — ``drain`` flush
+        reason). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            self._drain_req += 1
+            self._cond.notify_all()
+            try:
+                while self._outstanding > 0:
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cond.wait(remaining if remaining is None
+                                    else min(remaining, 0.1))
+            finally:
+                self._drain_req -= 1
+                self._cond.notify_all()
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Clean shutdown: stop admitting, process everything already
+        queued/staged/in flight, then stop the worker. Idempotent."""
+        with self._lock:
+            if self._closed and not self._worker.is_alive():
+                return
+            self._closing = True
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        with self._lock:
+            self._closed = True
+            if self._worker.is_alive():
+                log.warning("pipeline worker did not stop within %ss",
+                            timeout)
+
+    # -- introspection --------------------------------------------------------
+    def stats(self) -> Dict:
+        with self._lock:
+            queue_depth = len(self._queue)
+            outstanding = self._outstanding
+        qw = self.metrics.histograms.get("pipeline_queue_wait_seconds")
+        return {
+            "submitted": self.submitted,
+            "outstanding": outstanding,
+            "queue_depth": queue_depth,
+            "staged_rows": self._staged_rows,
+            "inflight": len(self._inflight),
+            "admission_drops": self.admission_drops,
+            "dispatched_batches": self.dispatched_batches,
+            "completed_batches": self.completed_batches,
+            "dispatch_faults": self.dispatch_faults,
+            "dispatch_errors": self.dispatch_errors,
+            "flush_reasons": dict(self.flush_reasons),
+            "fill_ratio_avg": round(self._fill_rows
+                                    / max(1, self._bucket_rows), 4),
+            "queue_wait_p50_ms": round(qw.quantile(0.5) * 1e3, 3)
+            if qw else 0.0,
+            "queue_wait_p99_ms": round(qw.quantile(0.99) * 1e3, 3)
+            if qw else 0.0,
+            "closed": self._closed or self._closing,
+        }
+
+    # -- worker side ----------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException:            # noqa: BLE001 — never strand tickets
+            log.exception("pipeline worker died; rejecting outstanding work")
+            exc = PipelineError("pipeline worker crashed")
+            with self._lock:
+                # flip closed under the lock FIRST so no producer can admit
+                # a ticket into the dead queue after we sweep it
+                self._closing = True
+                self._closed = True
+                pending = [s.ticket for s in self._queue]
+                self._queue.clear()
+            if self._current is not None:    # the sub that was mid-_ingest
+                pending.append(self._current.ticket)
+                self._current = None
+            pending.extend(sl.ticket for sl in self._staged_slices)
+            self._staged_slices = []
+            for inf in self._inflight:
+                pending.extend(sl.ticket for sl in inf.slices)
+            self._inflight.clear()
+            rejected = 0
+            for t in pending:
+                if not t.done():             # also dedups double-listed ones
+                    t._reject(exc)
+                    rejected += 1
+            with self._lock:
+                self._outstanding -= rejected
+                self._cond.notify_all()
+
+    def _run_inner(self) -> None:
+        while True:
+            sub = None
+            action = None
+            with self._lock:
+                while True:
+                    if self._queue:
+                        sub = self._queue.popleft()
+                        depth = len(self._queue)
+                        self.metrics.set_gauge("pipeline_queue_depth", depth)
+                        if depth >= self._queue_max - 1:
+                            self._cond.notify_all()   # wake blocked producers
+                        action = "ingest"
+                        break
+                    if self._staged_slices and (
+                            self._drain_req or self._closing
+                            or time.monotonic() >= self._stage_deadline):
+                        action = ("drain" if (self._drain_req
+                                              or self._closing)
+                                  else "deadline")
+                        break
+                    if self._inflight:
+                        # idle with work in flight: finalize eagerly so a
+                        # lone submission never waits for a successor
+                        action = "finalize"
+                        break
+                    if self._closing:
+                        return
+                    wait = None
+                    if self._staged_slices:
+                        wait = max(0.0, self._stage_deadline
+                                   - time.monotonic())
+                    self._cond.wait(wait)
+            if action == "ingest":
+                self._current = sub
+                self._ingest(sub)
+                self._current = None
+            elif action == "finalize":
+                self._finalize_oldest()
+            else:
+                self._flush(action)
+
+    def _ingest(self, sub: _Sub) -> None:
+        t = sub.ticket
+        m = t.n_valid
+        if m == 0:
+            # nothing to classify: resolve without a device round trip
+            self.metrics.histogram("pipeline_queue_wait_seconds").observe(
+                time.monotonic() - t.submitted_mono)
+            t._resolve(_zero_out(t.n_rows))
+            self._resolved(1)
+            return
+        rows = t.n_rows
+        if (self._staged_rows == 0
+                and self._min_bucket <= rows <= self._max_bucket
+                and rows & (rows - 1) == 0):
+            # already bucket-shaped: zero-copy direct dispatch
+            self._dispatch(sub.batch, sub.now,
+                           [_Slice(t, None, 0)], rows, m, "direct", None)
+            return
+        if self._staged_rows + m > self._max_bucket:
+            self._flush("full")
+        if self._stage_buf is None:
+            self._stage_buf = self._acquire_buffer()
+            # the deadline is anchored to the oldest rider's SUBMIT time so
+            # backlogged submissions flush immediately instead of waiting
+            # another full window
+            self._stage_deadline = t.submitted_mono + self._flush_s
+            self._stage_now = None
+        valid_idx = np.nonzero(np.asarray(sub.batch["valid"]))[0]
+        buf = self._buffers[self._stage_buf]
+        pos = self._staged_rows
+        for k, col in buf.items():
+            col[pos:pos + m] = np.asarray(sub.batch[k])[valid_idx]
+        if self._stage_now is None:
+            self._stage_now = sub.now
+        self._staged_slices.append(_Slice(t, valid_idx, pos))
+        self._staged_rows += m
+        if self._staged_rows >= self._max_bucket:
+            self._flush("full")
+
+    def _flush(self, reason: str) -> None:
+        if not self._staged_slices:
+            return
+        buf_idx = self._stage_buf
+        buf = self._buffers[buf_idx]
+        rows = self._staged_rows
+        bucket = max(self._min_bucket, _next_pow2(rows))
+        buf["valid"][rows:bucket] = False    # reused buffer: mask stale rows
+        view = {k: col[:bucket] for k, col in buf.items()}
+        slices = self._staged_slices
+        now = self._stage_now
+        self._stage_buf = None
+        self._staged_rows = 0
+        self._staged_slices = []
+        self._stage_now = None
+        self._dispatch(view, now, slices, bucket, rows, reason, buf_idx)
+
+    def _dispatch(self, batch: Dict[str, np.ndarray], now: Optional[int],
+                  slices: List[_Slice], bucket_rows: int, n_valid: int,
+                  reason: str, buf_idx: Optional[int]) -> None:
+        if now is None:
+            now = int(time.time())
+        self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
+        self.metrics.inc_counter(f"pipeline_flush_{reason}_total")
+        self._fill_rows += n_valid
+        self._bucket_rows += bucket_rows
+        self.metrics.set_gauge("pipeline_fill_ratio",
+                               round(n_valid / bucket_rows, 4))
+        t0 = time.monotonic()
+        qw = self.metrics.histogram("pipeline_queue_wait_seconds")
+        for sl in slices:
+            qw.observe(t0 - sl.ticket.submitted_mono)
+
+        attempts = 0
+        while True:
+            try:
+                FAULTS.fire("pipeline.dispatch")
+                finalize = self._dispatch_fn(batch, now)
+                break
+            except FaultInjected as e:
+                self.dispatch_faults += 1
+                self.metrics.inc_counter("pipeline_dispatch_faults_total")
+                attempts += 1
+                cap = (MAX_DISPATCH_RETRIES_CLOSING if self._closing
+                       else MAX_DISPATCH_RETRIES)
+                if attempts >= cap:
+                    self._reject_slices(slices, e, buf_idx)
+                    return
+                time.sleep(min(0.05, 0.0005 * (1 << min(attempts, 7))))
+            except Exception as e:   # noqa: BLE001 — supervised degradation
+                self.dispatch_errors += 1
+                self.metrics.inc_counter("pipeline_dispatch_errors_total")
+                log.warning("pipeline dispatch failed, rejecting %d "
+                            "submission(s): %s", len(slices), e)
+                self._reject_slices(slices, e, buf_idx)
+                return
+        self.dispatched_batches += 1
+        self._inflight.append(_Inflight(finalize, slices, t0, buf_idx))
+        self.metrics.set_gauge("pipeline_inflight", len(self._inflight))
+        # keep at most ``inflight`` batches genuinely in flight; the ring
+        # has inflight+1 staging buffers so the next microbatch can stage
+        # while the window is full
+        while len(self._inflight) > self._inflight_max:
+            self._finalize_oldest()
+
+    def _finalize_oldest(self) -> None:
+        if not self._inflight:
+            return
+        inf: _Inflight = self._inflight.popleft()
+        try:
+            out = inf.finalize()
+        except Exception as e:   # noqa: BLE001
+            self.dispatch_errors += 1
+            self.metrics.inc_counter("pipeline_dispatch_errors_total")
+            log.warning("pipeline finalize failed, rejecting %d "
+                        "submission(s): %s", len(inf.slices), e)
+            self._reject_slices(inf.slices, e, inf.buf_idx)
+            return
+        self.metrics.histogram("pipeline_batch_latency_seconds").observe(
+            time.monotonic() - inf.t_dispatch)
+        for sl in inf.slices:
+            if sl.valid_idx is None:        # direct: geometry already matches
+                sl.ticket._resolve(out)
+                continue
+            n = len(sl.valid_idx)
+            tout = _zero_out(sl.ticket.n_rows)
+            for k, arr in out.items():
+                if k not in tout:
+                    tout[k] = np.zeros((sl.ticket.n_rows,) + arr.shape[1:],
+                                       dtype=arr.dtype)
+                tout[k][sl.valid_idx] = arr[sl.dst_start:sl.dst_start + n]
+            sl.ticket._resolve(tout)
+        self.completed_batches += 1
+        self._recycle(inf.buf_idx)
+        self.metrics.set_gauge("pipeline_inflight", len(self._inflight))
+        self._resolved(len(inf.slices))
+
+    # -- small helpers ---------------------------------------------------------
+    def _acquire_buffer(self) -> int:
+        while not self._free_bufs:
+            self._finalize_oldest()
+        return self._free_bufs.pop()
+
+    def _recycle(self, buf_idx: Optional[int]) -> None:
+        if buf_idx is not None:
+            self._free_bufs.append(buf_idx)
+
+    def _reject_slices(self, slices: Sequence[_Slice], exc: BaseException,
+                       buf_idx: Optional[int]) -> None:
+        wrapped = exc if isinstance(exc, PipelineError) else \
+            PipelineError(f"dispatch failed: {type(exc).__name__}: {exc}")
+        wrapped.__cause__ = exc
+        for sl in slices:
+            sl.ticket._reject(wrapped)
+        self._recycle(buf_idx)
+        self._resolved(len(slices))
+
+    def _resolved(self, n: int) -> None:
+        with self._lock:
+            self._outstanding -= n
+            # drain waiters only care about reaching zero; producers are
+            # woken by the queue pop — skip the per-batch thundering herd
+            if self._outstanding == 0 or self._closing:
+                self._cond.notify_all()

@@ -154,6 +154,8 @@ def status_doc(engine: "Engine") -> Dict:
         "services": len(engine.ctx.services.all()),
         "conntrack": {"capacity": ct["capacity"], "live": ct["live"]},
         "enforcement_mode": engine.ctx.enforcement_mode,
+        # None until the ingestion pipeline has been started
+        "pipeline": engine.pipeline_stats(),
     }
 
 

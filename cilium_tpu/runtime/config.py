@@ -42,6 +42,13 @@ class DaemonConfig:
     # repository changelog instead of full recompiles (geometry changes
     # still fall back to a full build — compile/incremental.py gates)
     incremental: bool = True
+    # --- ingestion pipeline (pipeline/scheduler.py) ---
+    pipeline_queue_batches: int = 64    # bounded submission queue (batches)
+    pipeline_admission: str = "block"   # block (up to timeout) | drop
+    pipeline_block_timeout_s: float = 1.0
+    pipeline_flush_ms: float = 2.0      # microbatch coalesce deadline
+    pipeline_min_bucket: int = 256      # smallest dispatch shape (pow2)
+    pipeline_inflight: int = 2          # overlapped batches in flight
     # --- api ---
     api_socket: str = ""           # unix-socket REST path ("" = disabled)
     # --- multi-host sync (clustermesh analog; runtime/clustermesh.py) ---
@@ -62,6 +69,15 @@ class DaemonConfig:
             raise ValueError("ct_capacity must be a power of two")
         if self.flowlog_mode not in ("all", "drops", "none"):
             raise ValueError(f"bad flowlog mode {self.flowlog_mode!r}")
+        if self.pipeline_admission not in ("block", "drop"):
+            raise ValueError(
+                f"bad pipeline admission {self.pipeline_admission!r}")
+        if (self.pipeline_min_bucket <= 0
+                or self.pipeline_min_bucket & (self.pipeline_min_bucket - 1)):
+            raise ValueError("pipeline_min_bucket must be a power of two")
+        if self.pipeline_inflight < 1 or self.pipeline_queue_batches < 1:
+            raise ValueError(
+                "pipeline_inflight and pipeline_queue_batches must be >= 1")
 
     # -- sources -------------------------------------------------------------
     @classmethod

@@ -104,7 +104,24 @@ class DatapathBackend(abc.ABC):
                  ) -> Tuple[OutArrays, OutArrays]:
         """Classify one batch against a placed snapshot. Returns
         (out, counters) as numpy: out has at least allow/reason/status/
-        remote_identity; counters has by_reason_dir [512] + insert_fail."""
+        remote_identity; counters has by_reason_dir [C.COUNTER_CELLS]
+        (reasons x directions) + insert_fail."""
+
+    def classify_async(self, placed: Any, snap: PolicySnapshot,
+                       batch: Dict[str, np.ndarray], now: int):
+        """Enqueue one batch and return a zero-argument *finalize* callable
+        that blocks until the verdicts are ready and returns the same
+        (out, counters) tuple ``classify`` would.
+
+        The contract the pipeline scheduler builds on: everything ordering-
+        sensitive (CT mutation order) happens before this returns, so the
+        caller may stage/pack/transfer the NEXT batch while the device is
+        still computing this one. The default runs the backend's synchronous
+        ``classify`` eagerly (FakeDatapath: a plain queue — no device, no
+        overlap to win); the JIT backend overrides it with real async
+        dispatch."""
+        res = self.classify(placed, snap, batch, now)
+        return lambda: res
 
     @abc.abstractmethod
     def sweep(self, now: int) -> int:
@@ -234,9 +251,20 @@ class JITDatapath(DatapathBackend):
         return new_placed
 
     def classify(self, placed, snap, batch, now):
+        return self.classify_async(placed, snap, batch, now)()
+
+    def classify_async(self, placed, snap, batch, now):
+        """Async dispatch (SURVEY.md §5 / the pipeline's overlap stage):
+        host packing + transfer + XLA enqueue happen here, synchronously and
+        in CT order; the returned finalize materializes the out pytree to
+        numpy, which is where the host actually blocks on the device. Only
+        the donated CT buffers need the lock — ``out``/``counters`` are
+        fresh (non-donated) device arrays, safe to read after the lock is
+        released, and XLA sequences the donated-CT dependency chain across
+        in-flight steps by itself."""
         jnp = self._jnp
         if self._sharded:
-            return self._classify_sharded(placed, snap, batch, now)
+            return self._classify_async_sharded(placed, snap, batch, now)
         from cilium_tpu.kernels.records import (
             PACK4_EP_SLOT_MAX, _path_words_of, pack_batch, pack_batch_l7dict,
             pack_batch_v4)
@@ -263,11 +291,14 @@ class JITDatapath(DatapathBackend):
                 placed, self._ct, dev_batch, jnp.uint32(now),
                 jnp.int32(snap.world_index))
             self._ct = new_ct
+
+        def finalize():
             out_np = {k: np.asarray(v) for k, v in out.items()}
             counters_np = {k: np.asarray(v) for k, v in counters.items()}
-        return out_np, counters_np
+            return out_np, counters_np
+        return finalize
 
-    def _classify_sharded(self, placed, snap, batch, now):
+    def _classify_async_sharded(self, placed, snap, batch, now):
         from cilium_tpu.parallel.mesh import steer_batch, unsteer_outputs
         jnp = self._jnp
         # steering must hash the post-DNAT tuple (service flows' CT entries
@@ -280,9 +311,12 @@ class JITDatapath(DatapathBackend):
                 placed, self._ct, steered, jnp.uint32(now),
                 jnp.int32(snap.world_index))
             self._ct = new_ct
+
+        def finalize():
             out_np = {k: np.asarray(v) for k, v in out.items()}
             counters_np = {k: np.asarray(v) for k, v in counters.items()}
-        return unsteer_outputs(out_np, scatter), counters_np
+            return unsteer_outputs(out_np, scatter), counters_np
+        return finalize
 
     def sweep(self, now: int) -> int:
         from cilium_tpu.kernels import conntrack as ctk
@@ -402,7 +436,7 @@ class FakeDatapath(DatapathBackend):
                 "rnat_src": np.zeros((n, 4), np.uint32),
                 "rnat_sport": np.zeros(n, np.int32),
             }
-            counters = {"by_reason_dir": np.zeros(512, np.uint32),
+            counters = {"by_reason_dir": np.zeros(C.COUNTER_CELLS, np.uint32),
                         "insert_fail": np.uint32(0)}
             for i, p in enumerate(records):
                 if p is None:

@@ -96,6 +96,8 @@ class Engine:
         self._inc = None           # IncrementalCompiler, seeded on full build
         self._api = None           # APIServer when config.api_socket set
         self._mesh = None          # ClusterMesh when cluster_store set
+        self._pipeline = None      # ingestion Pipeline, started on demand
+        self._pipeline_stopped = False   # stop() bars lazy restart
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -324,6 +326,68 @@ class Engine:
                                   active.snapshot.ep_ids)
         return out
 
+    # -- pipelined ingestion (pipeline/scheduler.py) ----------------------------
+    def start_pipeline(self):
+        """The async ingestion path beside :meth:`classify`: a bounded-queue
+        scheduler that coalesces sub-full submissions into bucketed shapes
+        and overlaps host staging/transfer with the previous batch's device
+        compute (``DatapathBackend.classify_async``). Created lazily; knobs
+        come from ``DaemonConfig.pipeline_*``."""
+        with self._lock:
+            if self._pipeline is None:
+                from cilium_tpu.pipeline import Pipeline, PipelineClosed
+                if self._pipeline_stopped:
+                    raise PipelineClosed(
+                        "engine stopped; no new pipeline submissions")
+                cfg = self.config
+                self._pipeline = Pipeline(
+                    self._pipeline_dispatch, metrics=self.metrics,
+                    max_bucket=cfg.batch_size,
+                    min_bucket=min(cfg.pipeline_min_bucket, cfg.batch_size),
+                    queue_batches=cfg.pipeline_queue_batches,
+                    admission=cfg.pipeline_admission,
+                    block_timeout_s=cfg.pipeline_block_timeout_s,
+                    flush_ms=cfg.pipeline_flush_ms,
+                    inflight=cfg.pipeline_inflight)
+            return self._pipeline
+
+    def submit(self, batch: Dict[str, np.ndarray],
+               now: Optional[int] = None):
+        """Admit one batch into the ingestion pipeline; returns a Ticket
+        whose ``result()`` is bit-identical to what :meth:`classify` would
+        return for the same batch in the same order."""
+        return self.start_pipeline().submit(batch, now=now)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every pipeline submission so far has resolved."""
+        pl = self._pipeline           # local ref: stop() may null the field
+        if pl is None:
+            return True
+        return pl.drain(timeout=timeout)
+
+    def pipeline_stats(self) -> Optional[Dict]:
+        pl = self._pipeline
+        return pl.stats() if pl is not None else None
+
+    def _pipeline_dispatch(self, batch: Dict[str, np.ndarray], now: int):
+        """One microbatch through the datapath (called from the pipeline
+        worker). Captures the active snapshot per dispatch — same revision
+        fencing as classify — and defers metrics/flow-log to finalize, when
+        the verdicts are actually on the host."""
+        active = self.active
+        with self.metrics.span("pipeline_dispatch").timer():
+            fin = self.datapath.classify_async(
+                active.tensors, active.snapshot, batch, now)
+
+        def finalize():
+            out, counters = fin()
+            self.metrics.add_batch(counters,
+                                   int(np.asarray(batch["valid"]).sum()))
+            self.flowlog.append_batch(batch, out, now,
+                                      active.snapshot.ep_ids)
+            return out
+        return finalize
+
     def sweep(self, now: Optional[int] = None) -> int:
         """CT garbage collection (upstream ctmap GC)."""
         if now is None:
@@ -462,6 +526,12 @@ class Engine:
             os.replace(tmp, self.config.metrics_path)
 
     def stop(self) -> None:
+        with self._lock:
+            pl, self._pipeline = self._pipeline, None
+            self._pipeline_stopped = True    # submit() must not resurrect it
+        if pl is not None:
+            # clean shutdown: queued submissions are classified, not dropped
+            pl.close(timeout=30.0)
         self.controllers.stop_all()
         self._regen_trigger.cancel()
         if self._api is not None:

@@ -11,6 +11,8 @@ point                   fires in
 ``clustermesh.peer_read``  ``ClusterMesh._read_peers()`` — per peer file
 ``checkpoint.write``    ``checkpoint.save()`` — between tmp write and rename
 ``api.handler``         REST dispatch (every method) in ``api._Handler``
+``pipeline.dispatch``   per-microbatch dispatch in the ingestion pipeline
+                        worker (``pipeline/scheduler.py``)
 ======================  =====================================================
 
 Each point can be **armed** with one spec:
@@ -56,6 +58,9 @@ POINTS: Dict[str, str] = {
     "checkpoint.write": "pre-rename window of each atomic checkpoint file "
                         "write (tmp written, rename pending)",
     "api.handler": "REST request dispatch in the unix-socket API server",
+    "pipeline.dispatch": "per-microbatch dispatch in the ingestion "
+                         "pipeline worker (trips are retried — batches "
+                         "delay, never drop)",
 }
 
 

@@ -6,9 +6,10 @@ counters tensor — SURVEY.md §5: "counters tensor accumulated in-kernel
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -43,16 +44,74 @@ class SpanStat:
         return SpanStat._Timer(self)
 
 
+# Default latency buckets (seconds): sub-ms queue waits up to multi-second
+# stalls — the range the pipeline's queue-wait and batch-latency spans cover.
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+class Histogram:
+    """Prometheus histogram: cumulative ``_bucket`` counts + ``_sum`` /
+    ``_count`` (the le-labelled exposition format). ``SpanStat`` stays the
+    cheap count/total/max aggregate for existing spans; histograms are for
+    distributions where percentiles matter (queue wait, batch latency)."""
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        # counts[i] = observations <= buckets[i]; counts[-1] = +Inf bucket
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+        # own lock (not the Metrics one): observe() is the pipeline hot
+        # path; an unsynchronized render could otherwise scrape a bucket
+        # count ahead of +Inf — a non-monotonic histogram Prometheus rejects
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, value)] += 1
+            self.total += value
+            self.count += 1
+
+    def snapshot(self) -> Tuple[Tuple[float, ...], List[int], float, int]:
+        """Consistent (buckets, counts, sum, count) for rendering."""
+        with self._lock:
+            return self.buckets, list(self.counts), self.total, self.count
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from the bucket counts (linear within the
+        winning bucket). For observations past the last finite boundary the
+        boundary itself is returned — a histogram cannot do better."""
+        buckets, counts, _total, count = self.snapshot()
+        if count == 0:
+            return 0.0
+        target = q * count
+        acc = 0
+        lo = 0.0
+        for i, b in enumerate(buckets):
+            if counts[i]:
+                if acc + counts[i] >= target:
+                    frac = (target - acc) / counts[i]
+                    return lo + frac * (b - lo)
+                acc += counts[i]
+            lo = b
+        return buckets[-1]
+
+
 class Metrics:
     """Accumulates device counter outputs + host-side spans/gauges."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.by_reason_dir = np.zeros((512,), dtype=np.uint64)
+        # shape derived from the counter-tensor geometry in constants —
+        # a DropReason added past the old hard-coded 512 can no longer
+        # silently truncate (add_batch validates the incoming shape too)
+        self.by_reason_dir = np.zeros((C.COUNTER_CELLS,), dtype=np.uint64)
         self.insert_fail = 0
         self.packets_total = 0
         self.batches_total = 0
         self.spans: Dict[str, SpanStat] = {}
+        self.histograms: Dict[str, Histogram] = {}
         self.gauges: Dict[str, float] = {}
         self.counters: Dict[str, int] = {}
 
@@ -62,10 +121,25 @@ class Metrics:
                 self.spans[name] = SpanStat()
             return self.spans[name]
 
-    def add_batch(self, counters: Dict, n_valid: int) -> None:
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        """Named histogram (created on first use; ``buckets`` only applies
+        then). Name it like a Prometheus metric, e.g.
+        ``pipeline_queue_wait_seconds``."""
         with self._lock:
-            self.by_reason_dir += np.asarray(
-                counters["by_reason_dir"]).astype(np.uint64)
+            if name not in self.histograms:
+                self.histograms[name] = Histogram(buckets)
+            return self.histograms[name]
+
+    def add_batch(self, counters: Dict, n_valid: int) -> None:
+        arr = np.asarray(counters["by_reason_dir"])
+        if arr.shape != self.by_reason_dir.shape:
+            raise ValueError(
+                f"by_reason_dir shape {arr.shape} != expected "
+                f"{self.by_reason_dir.shape} (reasons x directions from "
+                f"constants — kernel and metrics geometry diverged)")
+        with self._lock:
+            self.by_reason_dir += arr.astype(np.uint64)
             self.insert_fail += int(counters["insert_fail"])
             self.packets_total += n_valid
             self.batches_total += 1
@@ -88,13 +162,14 @@ class Metrics:
             lines.append("# HELP ciliumtpu_datapath_verdicts_total Verdicts "
                          "by drop reason and direction")
             lines.append("# TYPE ciliumtpu_datapath_verdicts_total counter")
-            arr = self.by_reason_dir.reshape(256, 2)
+            arr = self.by_reason_dir.reshape(C.DROP_REASON_BINS,
+                                             C.N_DIRECTIONS)
             for reason in np.nonzero(arr.sum(axis=1))[0]:
                 try:
                     rname = C.DropReason(int(reason)).name
                 except ValueError:
                     rname = str(int(reason))
-                for d in (0, 1):
+                for d in range(C.N_DIRECTIONS):
                     if arr[reason, d]:
                         lines.append(
                             f'ciliumtpu_datapath_verdicts_total{{reason="{rname}",'
@@ -116,4 +191,16 @@ class Metrics:
                 lines.append(f"ciliumtpu_{name}_seconds_count {s.count}")
                 lines.append(f"ciliumtpu_{name}_seconds_sum {s.total_s:.6f}")
                 lines.append(f"ciliumtpu_{name}_seconds_max {s.max_s:.6f}")
+            for name, h in sorted(self.histograms.items()):
+                buckets, counts, total, count = h.snapshot()
+                lines.append(f"# TYPE ciliumtpu_{name} histogram")
+                acc = 0
+                for le, n in zip(buckets, counts):
+                    acc += n
+                    lines.append(
+                        f'ciliumtpu_{name}_bucket{{le="{le}"}} {acc}')
+                lines.append(
+                    f'ciliumtpu_{name}_bucket{{le="+Inf"}} {count}')
+                lines.append(f"ciliumtpu_{name}_sum {total:.6f}")
+                lines.append(f"ciliumtpu_{name}_count {count}")
         return "\n".join(lines) + "\n"

@@ -139,6 +139,18 @@ class DropReason(enum.IntEnum):
     NO_SERVICE = 140          # dst matched a service frontend with no backends
 
 
+# Geometry of the per-batch verdict counters tensor (kernels/classify.py
+# accumulates drops by reason x direction in-kernel; runtime/metrics.py
+# aggregates the same shape on the host). Reason ids are an 8-bit field.
+DROP_REASON_BINS = 256
+COUNTER_CELLS = DROP_REASON_BINS * N_DIRECTIONS
+
+if int(max(DropReason)) >= DROP_REASON_BINS:
+    raise AssertionError(
+        "DropReason value exceeds DROP_REASON_BINS — widen the counter "
+        "tensor geometry before adding reasons past the 8-bit field")
+
+
 # --------------------------------------------------------------------------- #
 # Conntrack (upstream: bpf/lib/conntrack.h, pkg/maps/ctmap)
 # --------------------------------------------------------------------------- #

@@ -656,9 +656,11 @@ class TestChaosCLI:
         assert doc["ok"] is True
         phases = {p["phase"]: p for p in doc["phases"]}
         assert set(phases) == {"regen-storm", "regen-recovery", "peer-flap",
-                               "checkpoint-corruption"}
+                               "pipeline-storm", "checkpoint-corruption"}
         assert all(p["ok"] for p in doc["phases"])
         assert "0 classify errors" in phases["regen-storm"]["detail"]
+        assert "0 errors, 0 verdict divergences" in \
+            phases["pipeline-storm"]["detail"]
 
     @pytest.mark.slow
     def test_chaos_scenario_jit_datapath(self, capsys):

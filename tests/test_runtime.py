@@ -366,6 +366,87 @@ class TestFlowLogSinkCap:
         assert log.flush_sink() == 10
         assert log._sink_buf == []
 
+    @staticmethod
+    def _mk_batch_out(n):
+        batch = {
+            "src": np.zeros((n, 4), np.uint32),
+            "dst": np.zeros((n, 4), np.uint32),
+            "sport": np.arange(n, dtype=np.uint32),
+            "dport": np.zeros(n, np.uint32),
+            "proto": np.full(n, 6, np.uint32),
+            "direction": np.zeros(n, np.uint32),
+            "ep_slot": np.zeros(n, np.uint32), "valid": np.ones(n, bool),
+        }
+        out = {
+            "allow": np.ones(n, bool), "reason": np.zeros(n, np.uint32),
+            "status": np.zeros(n, np.uint32),
+            "remote_identity": np.zeros(n, np.uint32),
+        }
+        return batch, out
+
+    def test_sink_rotation_at_rotate_bytes(self, tmp_path, monkeypatch):
+        """Past SINK_ROTATE_BYTES the sink rotates to <path>.1 (keep one
+        generation); new lines land in a fresh file."""
+        from cilium_tpu.runtime import flowlog as fl
+        monkeypatch.setattr(fl, "SINK_ROTATE_BYTES", 256)
+        path = tmp_path / "flows.jsonl"
+        log = fl.FlowLog(capacity=8, mode="all", sink_path=str(path))
+        batch, out = self._mk_batch_out(3)
+        log.append_batch(batch, out, now=1, ep_ids=(1,))
+        log.flush_sink()
+        assert path.stat().st_size > 256   # one flush already past the cap
+        first_gen = path.read_text()
+        log.append_batch(batch, out, now=2, ep_ids=(1,))
+        log.flush_sink()                   # this flush must rotate first
+        rotated = tmp_path / "flows.jsonl.1"
+        assert rotated.exists() and rotated.read_text() == first_gen
+        fresh = [json.loads(line) for line in
+                 path.read_text().strip().splitlines()]
+        assert len(fresh) == 3 and all(r["time"] == 2 for r in fresh)
+
+    def test_extract_capped_keeps_newest(self, monkeypatch):
+        """A drop-storm batch larger than APPEND_BATCH_MAX only extracts
+        the newest rows; the shed remainder is counted, and the ring still
+        sees every extracted record."""
+        from cilium_tpu.runtime import flowlog as fl
+        monkeypatch.setattr(fl, "APPEND_BATCH_MAX", 5)
+        log = fl.FlowLog(capacity=16, mode="all")
+        batch, out = self._mk_batch_out(12)
+        log.append_batch(batch, out, now=1, ep_ids=(1,))
+        assert log.extract_shed == 12 - 5
+        assert log.total_seen == 12
+        tail = log.tail()
+        assert [r["src_port"] for r in tail] == list(range(7, 12))
+
+
+class TestMetricsHistogram:
+    def test_observe_quantile_and_render(self):
+        from cilium_tpu.runtime.metrics import Histogram, Metrics
+        m = Metrics()
+        h = m.histogram("pipeline_queue_wait_seconds")
+        assert m.histogram("pipeline_queue_wait_seconds") is h  # idempotent
+        for v in (0.0002, 0.0002, 0.003, 0.02, 7.0):
+            h.observe(v)
+        assert h.count == 5 and h.total == pytest.approx(7.0234)
+        assert 0.0001 <= h.quantile(0.5) <= 0.005
+        assert h.quantile(0.999) == h.buckets[-1]   # past last finite bound
+        text = m.render_prometheus()
+        assert ("# TYPE ciliumtpu_pipeline_queue_wait_seconds histogram"
+                in text)
+        assert 'pipeline_queue_wait_seconds_bucket{le="+Inf"} 5' in text
+        assert "pipeline_queue_wait_seconds_count 5" in text
+        assert Histogram().quantile(0.5) == 0.0     # empty histogram
+
+    def test_counter_geometry_from_constants(self):
+        from cilium_tpu.runtime.metrics import Metrics
+        m = Metrics()
+        assert m.by_reason_dir.shape == (C.DROP_REASON_BINS
+                                         * C.N_DIRECTIONS,)
+        bad = {"by_reason_dir": np.zeros(512 + 2, np.uint32),
+               "insert_fail": np.uint32(0)}
+        with pytest.raises(ValueError, match="geometry"):
+            m.add_batch(bad, n_valid=0)
+
 
 class TestRegenFailureVisibility:
     def test_regen_failure_logged_and_counted(self, caplog):
