@@ -8,7 +8,7 @@
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast chaos pipeline-smoke shim bench clean
+.PHONY: test test-fast chaos pipeline-smoke observe-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -34,6 +34,15 @@ chaos:
 pipeline-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m "not slow"
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m slow
+
+# Observability gate (cilium_tpu/observe/): the tier-1 observe + pipeline
+# subset (tracer sampling/ring, flow-metrics windows, autotuner hysteresis/
+# convergence, tracing-on parity) plus the slow-marked sampled-trace soak —
+# pipeline throughput with tracing at 1/64 vs disabled, asserting <2%
+# overhead (the "hot path pays only a counter" contract).
+observe-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_observe.py tests/test_pipeline.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_observe.py -q -m slow
 
 shim:
 	$(MAKE) -C cilium_tpu/shim

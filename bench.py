@@ -710,7 +710,8 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
 
 
 def pipeline_bench(config: int, preset: str, batch: int, batches: int,
-                   windows: int = 3, verbose: bool = False):
+                   windows: int = 3, verbose: bool = False,
+                   trace: bool = False):
     """Serial vs pipelined ingestion on one config, through the real
     ``DatapathBackend`` boundary (JITDatapath behind the Pipeline
     scheduler), over the same ingest stream: the shim's rx polls deliver
@@ -727,11 +728,19 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
 
     Same flows, same CT geometry, same kernel — the delta is scheduling.
     """
+    from cilium_tpu.observe.trace import TRACER
     from cilium_tpu.pipeline import Pipeline
     from cilium_tpu.runtime.config import DaemonConfig
     from cilium_tpu.runtime.datapath import JITDatapath
     from cilium_tpu.runtime.metrics import Metrics
 
+    if trace:
+        # --trace: sample every submission so the per-stage summary in the
+        # JSON artifact covers the whole run (admission/microbatch/dispatch/
+        # finalize + the datapath's pack/transfer/compute split). This is
+        # the diagnostic mode — production sampling is 1/64-style.
+        TRACER.configure(sample_rate=1.0, capacity=65536)
+        TRACER.reset()
     t0 = time.time()
     snap, gen, v4_only = BUILDERS[config](preset)
     compile_s = time.time() - t0
@@ -829,6 +838,9 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
         "batch": batch,
         "batches": batches,
         "preset": preset,
+        # --trace: per-stage span summary (p50/p99/max per stage, ms)
+        **({"trace_spans": TRACER.summary(),
+            "trace_stats": TRACER.stats()} if trace else {}),
     }
 
 
@@ -846,6 +858,10 @@ def main(argv=None):
                     help="pipelined-ingestion mode: serial vs overlapped "
                          "(pipeline/scheduler.py) throughput on --config, "
                          "one JSON line with queue-wait and fill-ratio")
+    ap.add_argument("--trace", action="store_true",
+                    help="with --pipeline: record observe/trace spans at "
+                         "sampling 1.0 and emit the per-stage p50/p99 "
+                         "summary in the JSON artifact")
     ap.add_argument("--shards", type=int, default=1,
                     help="flow shards (data-parallel mesh axis); >1 routes "
                          "through the production multi-chip path")
@@ -886,7 +902,7 @@ def main(argv=None):
     if args.pipeline:
         result = pipeline_bench(args.config, preset, batch, batches,
                                 windows=max(3, args.windows - 2),
-                                verbose=args.verbose)
+                                verbose=args.verbose, trace=args.trace)
         _progress["headline"] = result
         print(json.dumps(result))
         return

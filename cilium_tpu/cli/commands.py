@@ -100,12 +100,39 @@ def register(sub: "argparse._SubParsersAction") -> None:
     p.set_defaults(func=_cmd_monitor)
 
     p = sub.add_parser("metrics", help="print the Prometheus text file the "
-                                       "engine exports")
+                                       "engine exports; `metrics flows` "
+                                       "shows the windowed flow-metrics "
+                                       "time-series (hubble metrics analog)")
+    p.add_argument("what", nargs="?", choices=["flows"],
+                   help="'flows': windowed verdict/drop/proto/port/identity "
+                        "series from /v1/flows/metrics (needs --api)")
     p.add_argument("--metrics-path",
                    help="DaemonConfig.metrics_path file")
     p.add_argument("--api", metavar="SOCKET",
                    help="live mode: scrape a running engine's REST socket")
+    p.add_argument("--last", type=int, default=0,
+                   help="flows mode: only the newest N windows")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="text")
     p.set_defaults(func=_cmd_metrics)
+
+    p = sub.add_parser(
+        "trace", help="sampled serving-path spans from a live agent: "
+                      "per-stage p50/p99 summary + recent spans "
+                      "(observe/trace.py; enable with "
+                      "CILIUM_TPU_TRACE_SAMPLE_RATE)")
+    p.add_argument("--api", metavar="SOCKET", required=True,
+                   help="the running engine's REST socket (spans live "
+                        "in-memory; there is no offline mode)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="recent spans to fetch")
+    p.add_argument("--name", help="filter spans by stage name "
+                                  "(e.g. pipeline.dispatch)")
+    p.add_argument("--spans", action="store_true",
+                   help="print individual spans, not just the summary")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="text")
+    p.set_defaults(func=_cmd_trace)
 
     p = sub.add_parser(
         "verify", help="compile every datapath config combo and check the "
@@ -263,6 +290,16 @@ def _cmd_status(args) -> int:
             print(f"  drops/faults:   {pl.get('admission_drops')} admission,"
                   f" {pl.get('dispatch_faults')} dispatch faults,"
                   f" {pl.get('dispatch_errors')} errors")
+        at = d.get("autotune")
+        if at:
+            print(f"Autotune:         flush_ms={at.get('flush_ms')}"
+                  f" min_bucket={at.get('min_bucket')}"
+                  f" adjustments={at.get('adjustments_total')}")
+        tr = d.get("trace")
+        if tr and tr.get("enabled"):
+            print(f"Tracing:          rate={tr.get('sample_rate')}"
+                  f" sampled={tr.get('sampled_total')}"
+                  f" ring={tr.get('spans_in_ring')}/{tr.get('capacity')}")
 
     if args.api:
         return _live_emit(args, "GET", "/v1/status", text_fn=text)
@@ -618,7 +655,38 @@ def _cmd_monitor(args) -> int:
             return 0
 
 
+def _flowmetrics_text(doc) -> None:
+    for w in doc.get("windows", []):
+        total = w["forwarded"] + w["dropped"]
+        drops = " ".join(f"{k}={v}" for k, v in
+                         sorted(w["drop_reasons"].items()))
+        ports = ",".join(f"{p['port']}:{p['count']}"
+                         for p in w["top_ports"][:5])
+        print(f"[{w['window_start']}+{w['window_s']}s] "
+              f"flows={total} fwd={w['forwarded']} drop={w['dropped']}"
+              + (f" reasons[{drops}]" if drops else "")
+              + (f" ports[{ports}]" if ports else ""))
+    t = doc.get("totals", {})
+    print(f"totals: fwd={t.get('forwarded')} drop={t.get('dropped')} "
+          f"batches={t.get('batches')}")
+
+
 def _cmd_metrics(args) -> int:
+    if args.what == "flows":
+        if not args.api:
+            print("metrics flows reads the live windowed series; "
+                  "--api SOCKET is required", file=sys.stderr)
+            return 1
+        path = "/v1/flows/metrics"
+        if args.last:
+            path += f"?last={args.last}"
+        return _live_emit(args, "GET", path, text_fn=_flowmetrics_text)
+    if args.output == "json":
+        # the Prometheus exposition is text by definition; silently
+        # handing unparseable text to a -o json caller would be worse
+        print("-o json applies to `metrics flows`; the Prometheus "
+              "exposition is text-only", file=sys.stderr)
+        return 1
     if args.api:
         from cilium_tpu.runtime.api import UnixAPIClient
         status, text = UnixAPIClient(args.api).get("/v1/metrics")
@@ -635,6 +703,37 @@ def _cmd_metrics(args) -> int:
         return 1
     with open(args.metrics_path) as f:
         sys.stdout.write(f.read())
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    path = f"/v1/trace?limit={args.limit}"
+    if args.name:
+        path += f"&name={args.name}"
+    doc = _live(args, "GET", path)
+    if args.output == "json":
+        print(json.dumps(doc, indent=2, default=str))
+        return 0
+    st = doc.get("stats", {})
+    if not st.get("enabled"):
+        print("tracing is disabled (set trace_sample_rate, e.g. "
+              "CILIUM_TPU_TRACE_SAMPLE_RATE=0.015625 for 1/64)")
+    print(f"sampled={st.get('sampled_total')} "
+          f"in_ring={st.get('spans_in_ring')}/{st.get('capacity')} "
+          f"rate={st.get('sample_rate')}")
+    summary = doc.get("summary", {})
+    if summary:
+        print(f"{'stage':<24} {'count':>7} {'p50 ms':>10} {'p99 ms':>10} "
+              f"{'max ms':>10}")
+        for name, s in summary.items():
+            print(f"{name:<24} {s['count']:>7} {s['p50_ms']:>10.3f} "
+                  f"{s['p99_ms']:>10.3f} {s['max_ms']:>10.3f}")
+    if args.spans:
+        for sp in doc.get("spans", []):
+            attrs = sp.get("attrs")
+            print(f"  trace={sp['trace_id']:<8} {sp['name']:<24} "
+                  f"{sp['duration_ms']:.3f}ms"
+                  + (f" {attrs}" if attrs else ""))
     return 0
 
 

@@ -1,0 +1,24 @@
+"""Observability + adaptive control (the Hubble observer/metrics analog,
+SURVEY.md §3.6, plus the adaptive-batching controller SURVEY §3.4 names).
+
+Three parts behind one package:
+
+- ``trace``       — low-overhead sampled span recorder for the serving path
+                    (admission → microbatch → stage → dispatch → finalize,
+                    pack/transfer/compute inside the datapath, regen/compile
+                    in the engine). Unsampled events pay one counter.
+- ``flowmetrics`` — Hubble-metrics analog: vectorized per-batch verdict /
+                    drop-reason / protocol / port / identity aggregation
+                    into windowed time-series (no per-record Python).
+- ``autotune``    — a controller that closes the loop: consumes the
+                    queue-wait and fill-ratio histograms the pipeline
+                    already exports and adjusts ``pipeline_flush_ms`` and
+                    the active bucket floor within configured bounds
+                    (hysteresis + capped steps, off by default).
+"""
+
+from cilium_tpu.observe.trace import TRACER, Tracer  # noqa: F401
+from cilium_tpu.observe.flowmetrics import FlowMetrics  # noqa: F401
+from cilium_tpu.observe.autotune import Autotuner  # noqa: F401
+
+__all__ = ["TRACER", "Tracer", "FlowMetrics", "Autotuner"]

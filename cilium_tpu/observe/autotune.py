@@ -1,0 +1,202 @@
+"""Histogram-driven pipeline autotuning (SURVEY.md §3.4 "adaptive batching";
+ROADMAP open item: "autoscale ``pipeline_flush_ms`` / bucket choice from
+observed queue-wait histograms").
+
+The pipeline already exports exactly the signals a controller needs — the
+``pipeline_queue_wait_seconds`` histogram and the fill/bucket row counters —
+so the autotuner is a pure consumer: each :meth:`step` diffs those against
+its previous snapshot (so every decision is about the *last interval*, not
+the process lifetime) and nudges two knobs inside configured bounds:
+
+- ``flush_ms`` — queue-wait p99 over budget → flush sooner (down); fill
+  ratio under target while p99 is comfortably under half the budget →
+  coalesce longer (up).
+- ``min_bucket`` (the smallest dispatch shape) — deadline-dominated flushes
+  at low fill → smaller floor (less padding per dispatch); near-full
+  dispatches everywhere → larger floor (fewer, bigger device steps).
+
+Stability over reactivity: a change needs ``hysteresis`` *consecutive*
+same-direction intervals, steps are capped multiplicative factors, and the
+up/down conditions leave a dead band between them — the combination is what
+the oscillation test in ``tests/test_observe.py`` pins. Decisions are
+themselves traced (``autotune.decision`` events) and counted, so a
+misbehaving controller is observable through the subsystem it drives.
+Disabled by default (``DaemonConfig.autotune_enabled``).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from cilium_tpu.observe.trace import TRACER, Tracer
+from cilium_tpu.runtime.metrics import Metrics, quantile_from
+
+log = logging.getLogger("cilium_tpu.autotune")
+
+QUEUE_WAIT_HIST = "pipeline_queue_wait_seconds"
+
+
+class Autotuner:
+    """``pipeline`` needs ``stats()`` (with ``fill_rows``/``bucket_rows``/
+    ``flush_reasons``), ``flush_ms``/``min_bucket``/``max_bucket`` and the
+    ``set_flush_ms``/``set_min_bucket`` setters — the real Pipeline, or a
+    stub in tests."""
+
+    def __init__(self, pipeline, metrics: Metrics,
+                 tracer: Optional[Tracer] = None, *,
+                 flush_ms_min: float = 0.5, flush_ms_max: float = 20.0,
+                 min_bucket_floor: Optional[int] = None,
+                 target_fill: float = 0.7,
+                 queue_wait_p99_budget_ms: float = 10.0,
+                 hysteresis: int = 3, step_factor: float = 1.5,
+                 min_interval_batches: int = 4):
+        if flush_ms_min <= 0 or flush_ms_max < flush_ms_min:
+            raise ValueError("need 0 < flush_ms_min <= flush_ms_max")
+        if hysteresis < 1 or step_factor <= 1.0:
+            raise ValueError("hysteresis >= 1 and step_factor > 1 required")
+        self.pipeline = pipeline
+        self.metrics = metrics
+        self.tracer = tracer if tracer is not None else TRACER
+        self.flush_ms_min = flush_ms_min
+        self.flush_ms_max = flush_ms_max
+        self.min_bucket_floor = min_bucket_floor
+        self.target_fill = target_fill
+        self.budget_ms = queue_wait_p99_budget_ms
+        self.hysteresis = hysteresis
+        self.step_factor = step_factor
+        self.min_interval_batches = min_interval_batches
+
+        self._last_counts: Optional[List[int]] = None
+        self._last_fill = (0, 0)
+        self._last_dispatched = 0
+        self._last_reasons: Dict[str, int] = {}
+        self._flush_streak = 0          # +n consecutive "up", -n "down"
+        self._bucket_streak = 0
+        # bounded decision history (the /v1/status surface only shows the
+        # tail; a long-lived daemon must not accumulate dicts forever)
+        self.adjustments: Deque[Dict] = deque(maxlen=64)
+        self.adjustments_total = 0
+
+    # -- the control step ----------------------------------------------------
+    def step(self) -> Optional[Dict]:
+        """One control interval. Returns the observation/decision record,
+        or None when there is not enough fresh signal to act on."""
+        pl = self.pipeline
+        hist = self.metrics.histograms.get(QUEUE_WAIT_HIST)
+        if hist is None:
+            return None
+        buckets, counts, _total, _n = hist.snapshot()
+        stats = pl.stats()
+        fill_rows = stats.get("fill_rows", 0)
+        bucket_rows = stats.get("bucket_rows", 0)
+        dispatched = stats.get("dispatched_batches", 0)
+
+        reasons = dict(stats.get("flush_reasons", {}))
+
+        if self._last_counts is None:
+            # first step: baseline only — a decision needs an interval
+            self._remember(counts, fill_rows, bucket_rows, dispatched,
+                           reasons)
+            return None
+        d_counts = [c - p for c, p in zip(counts, self._last_counts)]
+        d_dispatched = dispatched - self._last_dispatched
+        d_fill = fill_rows - self._last_fill[0]
+        d_bucket = bucket_rows - self._last_fill[1]
+        d_reasons = {k: v - self._last_reasons.get(k, 0)
+                     for k, v in reasons.items()}
+        if d_dispatched < self.min_interval_batches or d_bucket <= 0:
+            return None                  # idle interval: keep the baseline
+        self._remember(counts, fill_rows, bucket_rows, dispatched, reasons)
+
+        p99_ms = quantile_from(buckets, d_counts, 0.99) * 1e3
+        fill = d_fill / d_bucket
+        obs = {"queue_wait_p99_ms": round(p99_ms, 3),
+               "fill_ratio": round(fill, 4),
+               "flush_ms": pl.flush_ms, "min_bucket": pl.min_bucket,
+               "interval_batches": d_dispatched, "adjusted": []}
+
+        # -- flush_ms --------------------------------------------------------
+        if p99_ms > self.budget_ms:
+            want = -1
+        elif fill < self.target_fill and p99_ms < 0.5 * self.budget_ms:
+            want = +1
+        else:
+            want = 0
+        self._flush_streak = self._advance(self._flush_streak, want)
+        if abs(self._flush_streak) >= self.hysteresis:
+            old = pl.flush_ms
+            new = old * self.step_factor if self._flush_streak > 0 \
+                else old / self.step_factor
+            new = min(self.flush_ms_max, max(self.flush_ms_min, new))
+            if new != old:
+                pl.set_flush_ms(new)
+                self._decide(obs, "flush_ms", old, new)
+            self._flush_streak = 0
+
+        # -- min_bucket (the active bucket-set floor) ------------------------
+        floor = self.min_bucket_floor or 1
+        deadline_frac = d_reasons.get("deadline", 0) \
+            / max(1, sum(d_reasons.values()))
+        if fill < self.target_fill and deadline_frac > 0.5 \
+                and pl.min_bucket > floor:
+            bwant = -1
+        elif fill >= 0.95 and pl.min_bucket < pl.max_bucket:
+            bwant = +1
+        else:
+            bwant = 0
+        self._bucket_streak = self._advance(self._bucket_streak, bwant)
+        if abs(self._bucket_streak) >= self.hysteresis:
+            old_b = pl.min_bucket
+            new_b = old_b * 2 if self._bucket_streak > 0 else old_b // 2
+            new_b = min(pl.max_bucket, max(floor, new_b))
+            if new_b != old_b:
+                pl.set_min_bucket(new_b)
+                self._decide(obs, "min_bucket", old_b, new_b)
+            self._bucket_streak = 0
+
+        self.metrics.set_gauge("autotune_flush_ms", pl.flush_ms)
+        self.metrics.set_gauge("autotune_min_bucket", pl.min_bucket)
+        return obs
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _advance(streak: int, want: int) -> int:
+        if want == 0:
+            return 0
+        if (streak > 0) == (want > 0) and streak != 0:
+            return streak + want
+        return want
+
+    def _remember(self, counts, fill_rows, bucket_rows, dispatched,
+                  reasons) -> None:
+        self._last_counts = list(counts)
+        self._last_fill = (fill_rows, bucket_rows)
+        self._last_dispatched = dispatched
+        self._last_reasons = reasons
+
+    def _decide(self, obs: Dict, knob: str, old, new) -> None:
+        rec = {"knob": knob, "old": old, "new": new,
+               "queue_wait_p99_ms": obs["queue_wait_p99_ms"],
+               "fill_ratio": obs["fill_ratio"]}
+        self.adjustments.append(rec)
+        self.adjustments_total += 1
+        obs["adjusted"].append(rec)
+        obs[knob] = new
+        self.metrics.inc_counter("autotune_adjustments_total")
+        self.tracer.event("autotune.decision", **rec)
+        log.info("autotune: %s %s -> %s (qw_p99=%.2fms fill=%.2f)",
+                 knob, old, new, obs["queue_wait_p99_ms"],
+                 obs["fill_ratio"])
+
+    def status(self) -> Dict:
+        return {
+            "flush_ms": self.pipeline.flush_ms,
+            "min_bucket": self.pipeline.min_bucket,
+            "bounds": {"flush_ms": [self.flush_ms_min, self.flush_ms_max],
+                       "min_bucket": [self.min_bucket_floor or 1,
+                                      self.pipeline.max_bucket]},
+            "adjustments": list(self.adjustments)[-20:],
+            "adjustments_total": self.adjustments_total,
+        }

@@ -1,0 +1,263 @@
+"""Flow-metrics aggregation (the Hubble-metrics analog, SURVEY.md §3.6:
+verdict/drop/protocol/port aggregations over the observed flow stream).
+
+``FlowLog`` keeps per-record detail for ``monitor``; this module keeps the
+*aggregates* a dashboard scrapes: per-batch verdict / drop-reason /
+protocol / destination-port / remote-identity counts bucketed into aligned
+time windows. Everything is vectorized over the already-extracted batch
+columns (``np.bincount`` / ``np.unique`` on the valid rows) — there is no
+per-record Python on this path, so it can sit beside ``FlowLog.append_batch``
+on the pipelined serving path.
+
+Cardinality is bounded: reason/proto axes are fixed-size bincounts; the
+open-ended port/identity axes are capped per window (drop-smallest into an
+``other`` bucket) so a port scan cannot balloon host memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu.utils import constants as C
+
+#: per-window cap on the open-ended axes (ports, identities); the smallest
+#: counts collapse into "other" past this
+AXIS_CAP = 256
+
+
+def _top_uniques(values: np.ndarray):
+    """(keys, counts, dropped_total) for the AXIS_CAP most frequent
+    values — the vectorized truncation that keeps the lock-held dict merge
+    bounded no matter how many distinct ports/identities one batch holds
+    (a port scan yields thousands of uniques per batch)."""
+    keys, counts = np.unique(values, return_counts=True)
+    if keys.size <= AXIS_CAP:
+        return keys, counts, 0
+    sel = np.argpartition(counts, -AXIS_CAP)[-AXIS_CAP:]
+    dropped = int(counts.sum() - counts[sel].sum())
+    return keys[sel], counts[sel], dropped
+
+
+def _merge_counts(dst: Dict[int, int], keys: np.ndarray,
+                  counts: np.ndarray) -> None:
+    for k, n in zip(keys.tolist(), counts.tolist()):
+        dst[k] = dst.get(k, 0) + n
+
+
+def _merge_capped(dst: Dict[int, int], keys: np.ndarray,
+                  counts: np.ndarray, other: int) -> int:
+    """Merge for the CUMULATIVE aggregate: established keys accumulate
+    forever; once ``dst`` is at capacity, counts for keys it has never
+    seen fold into ``other``. Never evicts — an exported Prometheus
+    series must stay monotone between scrapes, so first-kept beats
+    top-k here (the windows keep top-k; they are ephemeral JSON)."""
+    for k, n in zip(keys.tolist(), counts.tolist()):
+        if k in dst:
+            dst[k] += n
+        elif len(dst) < AXIS_CAP:
+            dst[k] = n
+        else:
+            other += n
+    return other
+
+
+def _prune(dst: Dict[int, int], other: int) -> int:
+    """Cap ``dst`` at AXIS_CAP entries; returns the new ``other`` total."""
+    if len(dst) <= AXIS_CAP:
+        return other
+    keep = sorted(dst.items(), key=lambda kv: kv[1], reverse=True)
+    for k, n in keep[AXIS_CAP:]:
+        other += n
+        del dst[k]
+    return other
+
+
+class _Window:
+    __slots__ = ("start", "forwarded", "dropped", "reasons", "protos",
+                 "ports", "identities", "ports_other", "identities_other")
+
+    def __init__(self, start: int):
+        self.start = start
+        self.forwarded = 0
+        self.dropped = 0
+        self.reasons = np.zeros(C.DROP_REASON_BINS, dtype=np.int64)
+        self.protos = np.zeros(256, dtype=np.int64)
+        self.ports: Dict[int, int] = {}
+        self.identities: Dict[int, int] = {}
+        self.ports_other = 0
+        self.identities_other = 0
+
+
+def _reason_name(r: int) -> str:
+    try:
+        return C.DropReason(r).name
+    except ValueError:
+        return str(r)
+
+
+class FlowMetrics:
+    def __init__(self, window_s: int = 10, n_windows: int = 60,
+                 top_k: int = 10):
+        if window_s < 1 or n_windows < 1:
+            raise ValueError("window_s and n_windows must be >= 1")
+        self.window_s = int(window_s)
+        self.n_windows = n_windows
+        self.top_k = top_k
+        self._lock = threading.Lock()
+        self._windows: deque = deque(maxlen=n_windows)
+        self._totals = _Window(0)
+        self.batches_total = 0
+
+    # -- hot path ------------------------------------------------------------
+    def add_batch(self, batch: Dict[str, np.ndarray],
+                  out: Dict[str, np.ndarray], now: int) -> None:
+        """Aggregate one classified batch. Vectorized column math happens
+        outside the lock; only the merge into the window is serialized."""
+        valid = np.asarray(batch["valid"])
+        idxs = np.nonzero(valid)[0]
+        if idxs.size == 0:
+            return
+        allow = np.asarray(out["allow"])[idxs]
+        n_fwd = int(allow.sum())
+        n_drop = int(idxs.size) - n_fwd
+        reasons = np.bincount(
+            np.asarray(out["reason"])[idxs[~allow]].astype(np.int64),
+            minlength=C.DROP_REASON_BINS) if n_drop else None
+        protos = np.bincount(
+            np.asarray(batch["proto"])[idxs].astype(np.int64) & 0xFF,
+            minlength=256)
+        ports, port_n, port_rest = _top_uniques(
+            np.asarray(batch["dport"])[idxs])
+        idents, ident_n, ident_rest = _top_uniques(
+            np.asarray(out["remote_identity"])[idxs])
+
+        wstart = int(now) - int(now) % self.window_s
+        with self._lock:
+            self.batches_total += 1
+            w = self._window_for(wstart)
+            t = self._totals
+            for agg in (w, t):
+                agg.forwarded += n_fwd
+                agg.dropped += n_drop
+                if reasons is not None:
+                    agg.reasons += reasons[:C.DROP_REASON_BINS]
+                agg.protos += protos
+            # window: top-k semantics, evicting prune (ephemeral JSON)
+            _merge_counts(w.ports, ports, port_n)
+            _merge_counts(w.identities, idents, ident_n)
+            w.ports_other = _prune(w.ports, w.ports_other + port_rest)
+            w.identities_other = _prune(w.identities,
+                                        w.identities_other + ident_rest)
+            # totals: monotone semantics — never evict an exported series
+            t.ports_other = _merge_capped(t.ports, ports, port_n,
+                                          t.ports_other + port_rest)
+            t.identities_other = _merge_capped(
+                t.identities, idents, ident_n,
+                t.identities_other + ident_rest)
+
+    def _window_for(self, wstart: int) -> _Window:
+        """Current window, advancing the ring as the clock crosses window
+        boundaries (out-of-order ``now`` within the retained ring lands in
+        its own window; older than the ring lands in the oldest kept)."""
+        for w in reversed(self._windows):
+            if w.start == wstart:
+                return w
+        if self._windows and wstart < self._windows[0].start:
+            return self._windows[0]
+        w = _Window(wstart)
+        if self._windows and wstart < self._windows[-1].start:
+            # rare out-of-order batch inside the retained range: insert sorted
+            items = sorted([*self._windows, w], key=lambda x: x.start)
+            self._windows = deque(items[-self.n_windows:],
+                                  maxlen=self.n_windows)
+        else:
+            self._windows.append(w)
+        return w
+
+    # -- read side -----------------------------------------------------------
+    def _doc(self, w: _Window) -> Dict:
+        nz = np.nonzero(w.reasons)[0]
+        top_ports = sorted(w.ports.items(), key=lambda kv: kv[1],
+                           reverse=True)[:self.top_k]
+        top_ids = sorted(w.identities.items(), key=lambda kv: kv[1],
+                         reverse=True)[:self.top_k]
+        pnz = np.nonzero(w.protos)[0]
+        return {
+            "window_start": w.start,
+            "window_s": self.window_s,
+            "forwarded": w.forwarded,
+            "dropped": w.dropped,
+            "drop_reasons": {_reason_name(int(r)): int(w.reasons[r])
+                             for r in nz},
+            "protos": {C.PROTO_NAMES.get(int(p), str(int(p))):
+                       int(w.protos[p]) for p in pnz},
+            "top_ports": [{"port": int(p), "count": n}
+                          for p, n in top_ports],
+            "top_identities": [{"identity": int(i), "count": n}
+                               for i, n in top_ids],
+        }
+
+    def series(self, last: int = 0) -> List[Dict]:
+        """Windowed time-series, oldest first (the /v1/flows/metrics body).
+        Docs are built under the lock — the newest window is live and a
+        mid-merge read would be internally inconsistent."""
+        with self._lock:
+            docs = [self._doc(w) for w in self._windows]
+        return docs[-last:] if last else docs
+
+    def totals(self) -> Dict:
+        with self._lock:
+            doc = self._doc(self._totals)
+        doc.pop("window_start")
+        doc.pop("window_s")
+        doc["batches"] = self.batches_total
+        return doc
+
+    def render_prometheus(self) -> str:
+        """Cumulative totals in Prometheus text format (appended after
+        ``Metrics.render_prometheus`` by the engine's exporters)."""
+        with self._lock:
+            t = self._totals
+            lines = [
+                "# TYPE ciliumtpu_flow_verdicts_total counter",
+                f'ciliumtpu_flow_verdicts_total{{verdict="FORWARDED"}} '
+                f"{t.forwarded}",
+                f'ciliumtpu_flow_verdicts_total{{verdict="DROPPED"}} '
+                f"{t.dropped}",
+            ]
+            nz = np.nonzero(t.reasons)[0]
+            if nz.size:
+                lines.append("# TYPE ciliumtpu_flow_drops_total counter")
+                lines.extend(
+                    f'ciliumtpu_flow_drops_total{{reason="{_reason_name(int(r))}"}} '
+                    f"{int(t.reasons[r])}" for r in nz)
+            pnz = np.nonzero(t.protos)[0]
+            if pnz.size:
+                lines.append("# TYPE ciliumtpu_flow_proto_total counter")
+                lines.extend(
+                    f'ciliumtpu_flow_proto_total{{proto='
+                    f'"{C.PROTO_NAMES.get(int(p), str(int(p)))}"}} '
+                    f"{int(t.protos[p])}" for p in pnz)
+            for label, counts, other in (
+                    ("port", t.ports, t.ports_other),
+                    ("identity", t.identities, t.identities_other)):
+                if not counts and not other:
+                    continue
+                # export every retained entry (bounded at AXIS_CAP) and the
+                # pruned remainder — both monotone between scrapes, which a
+                # Prometheus counter must be (folding a recomputed top-k
+                # tail into "other" per scrape would make it sawtooth)
+                lines.append(f"# TYPE ciliumtpu_flow_{label}_total counter")
+                lines.extend(
+                    f'ciliumtpu_flow_{label}_total{{{label}="{k}"}} {n}'
+                    for k, n in sorted(counts.items(), key=lambda kv: kv[1],
+                                       reverse=True))
+                if other:
+                    lines.append(
+                        f'ciliumtpu_flow_{label}_total{{{label}="other"}} '
+                        f"{other}")
+        return "\n".join(lines) + "\n"

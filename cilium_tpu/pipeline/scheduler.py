@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.observe.trace import TRACER, Tracer
 from cilium_tpu.runtime.faults import FAULTS, FaultInjected
 from cilium_tpu.runtime.metrics import Metrics
 
@@ -99,13 +100,14 @@ class Ticket:
     geometry as the submitted batch; invalid rows zero-filled, exactly like
     the serial classify path)."""
 
-    __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono",
+    __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono", "trace_id",
                  "_event", "_out", "_exc")
 
     def __init__(self, n_rows: int, n_valid: int):
         self.seq = -1                      # assigned at admission
         self.n_rows = n_rows
         self.n_valid = n_valid
+        self.trace_id = None               # observe/trace sampling decision
         self.submitted_mono = time.monotonic()
         self._event = threading.Event()
         self._out: Optional[Dict[str, np.ndarray]] = None
@@ -188,7 +190,8 @@ class Pipeline:
                  max_bucket: int = 8192, min_bucket: int = 256,
                  queue_batches: int = 64, admission: str = "block",
                  block_timeout_s: float = 1.0, flush_ms: float = 2.0,
-                 inflight: int = 2, name: str = "pipeline"):
+                 inflight: int = 2, name: str = "pipeline",
+                 tracer: Optional[Tracer] = None):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
@@ -200,6 +203,7 @@ class Pipeline:
             raise ValueError("inflight and queue_batches must be >= 1")
         self._dispatch_fn = dispatch_fn
         self.metrics = metrics if metrics is not None else Metrics()
+        self.tracer = tracer if tracer is not None else TRACER
         self._max_bucket = max_bucket
         self._min_bucket = min_bucket
         self._queue_max = queue_batches
@@ -264,6 +268,9 @@ class Pipeline:
                 f"submission has {n_valid} valid rows > max_bucket "
                 f"{self._max_bucket}; split it or raise batch_size")
         ticket = Ticket(n_rows=int(valid.shape[0]), n_valid=n_valid)
+        # the sampling decision is made once per submission and rides the
+        # ticket; unsampled submissions pay exactly one counter draw here
+        ticket.trace_id = self.tracer.maybe_sample()
         deadline = time.monotonic() + (
             self._block_timeout_s if timeout is None else timeout)
         with self._lock:
@@ -327,6 +334,37 @@ class Pipeline:
                 log.warning("pipeline worker did not stop within %ss",
                             timeout)
 
+    # -- runtime-tunable knobs (observe/autotune.py consumer) -----------------
+    @property
+    def flush_ms(self) -> float:
+        return self._flush_s * 1e3
+
+    @property
+    def min_bucket(self) -> int:
+        return self._min_bucket
+
+    @property
+    def max_bucket(self) -> int:
+        return self._max_bucket
+
+    def set_flush_ms(self, flush_ms: float) -> None:
+        """Retarget the microbatch coalesce deadline (applies to the next
+        staged submission; an already-armed deadline keeps its anchor)."""
+        if flush_ms <= 0:
+            raise ValueError("flush_ms must be > 0")
+        with self._lock:
+            self._flush_s = flush_ms / 1e3
+            self._cond.notify_all()     # re-evaluate a parked deadline wait
+
+    def set_min_bucket(self, min_bucket: int) -> None:
+        """Move the smallest dispatch shape (the bucket-set floor)."""
+        if min_bucket & (min_bucket - 1) or \
+                not 0 < min_bucket <= self._max_bucket:
+            raise ValueError("min_bucket must be a power of two "
+                             "<= max_bucket")
+        with self._lock:
+            self._min_bucket = min_bucket
+
     # -- introspection --------------------------------------------------------
     def stats(self) -> Dict:
         with self._lock:
@@ -345,6 +383,10 @@ class Pipeline:
             "dispatch_faults": self.dispatch_faults,
             "dispatch_errors": self.dispatch_errors,
             "flush_reasons": dict(self.flush_reasons),
+            "fill_rows": self._fill_rows,
+            "bucket_rows": self._bucket_rows,
+            "flush_ms": self.flush_ms,
+            "min_bucket": self._min_bucket,
             "fill_ratio_avg": round(self._fill_rows
                                     / max(1, self._bucket_rows), 4),
             "queue_wait_p50_ms": round(qw.quantile(0.5) * 1e3, 3)
@@ -432,8 +474,11 @@ class Pipeline:
         m = t.n_valid
         if m == 0:
             # nothing to classify: resolve without a device round trip
+            wait = time.monotonic() - t.submitted_mono
             self.metrics.histogram("pipeline_queue_wait_seconds").observe(
-                time.monotonic() - t.submitted_mono)
+                wait)
+            self.tracer.record(t.trace_id, "pipeline.admission",
+                               t.submitted_mono, wait)
             t._resolve(_zero_out(t.n_rows))
             self._resolved(1)
             return
@@ -457,8 +502,9 @@ class Pipeline:
         valid_idx = np.nonzero(np.asarray(sub.batch["valid"]))[0]
         buf = self._buffers[self._stage_buf]
         pos = self._staged_rows
-        for k, col in buf.items():
-            col[pos:pos + m] = np.asarray(sub.batch[k])[valid_idx]
+        with self.tracer.span(t.trace_id, "pipeline.microbatch", rows=m):
+            for k, col in buf.items():
+                col[pos:pos + m] = np.asarray(sub.batch[k])[valid_idx]
         if self._stage_now is None:
             self._stage_now = sub.now
         self._staged_slices.append(_Slice(t, valid_idx, pos))
@@ -498,12 +544,24 @@ class Pipeline:
         qw = self.metrics.histogram("pipeline_queue_wait_seconds")
         for sl in slices:
             qw.observe(t0 - sl.ticket.submitted_mono)
+            self.tracer.record(sl.ticket.trace_id, "pipeline.admission",
+                               sl.ticket.submitted_mono,
+                               t0 - sl.ticket.submitted_mono)
+        # the batch-level spans ride the first sampled rider's trace; the
+        # trace context makes the datapath's pack/transfer/compute split
+        # attach to the same trace id across the backend boundary
+        tid = next((sl.ticket.trace_id for sl in slices
+                    if sl.ticket.trace_id is not None), None)
 
         attempts = 0
         while True:
             try:
                 FAULTS.fire("pipeline.dispatch")
-                finalize = self._dispatch_fn(batch, now)
+                with self.tracer.context(tid), \
+                        self.tracer.span(tid, "pipeline.dispatch",
+                                         bucket=bucket_rows,
+                                         n_valid=n_valid, reason=reason):
+                    finalize = self._dispatch_fn(batch, now)
                 break
             except FaultInjected as e:
                 self.dispatch_faults += 1
@@ -535,8 +593,12 @@ class Pipeline:
         if not self._inflight:
             return
         inf: _Inflight = self._inflight.popleft()
+        tid = next((sl.ticket.trace_id for sl in inf.slices
+                    if sl.ticket.trace_id is not None), None)
         try:
-            out = inf.finalize()
+            with self.tracer.context(tid), \
+                    self.tracer.span(tid, "pipeline.finalize"):
+                out = inf.finalize()
         except Exception as e:   # noqa: BLE001
             self.dispatch_errors += 1
             self.metrics.inc_counter("pipeline_dispatch_errors_total")

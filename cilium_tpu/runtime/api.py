@@ -20,8 +20,13 @@ Routes (all under /v1):
   GET  /v1/services           service/LB state
   GET  /v1/ct?limit=N&now=T   live conntrack entries
   GET  /v1/flows?last=N&verdict=V   flow log tail
+  GET  /v1/flows/metrics?last=N     windowed flow-metrics time-series +
+                              cumulative totals (the hubble metrics analog)
+  GET  /v1/trace?limit=N&name=S     sampled span ring + per-stage summary
+                              (observe/trace.py; empty when tracing is off)
   GET  /v1/fqdn/cache         learned DNS names
-  GET  /v1/metrics            Prometheus text (text/plain)
+  GET  /v1/metrics            Prometheus text (text/plain), incl. flow
+                              metrics totals
   GET  /v1/config             daemon config echo (runtime-mutable subset)
   PATCH /v1/config            {"enforcement_mode": ...} (upstream: `cilium
                               config PolicyEnforcement=...`)
@@ -156,6 +161,9 @@ def status_doc(engine: "Engine") -> Dict:
         "enforcement_mode": engine.ctx.enforcement_mode,
         # None until the ingestion pipeline has been started
         "pipeline": engine.pipeline_stats(),
+        # None until the autotune controller has run against a pipeline
+        "autotune": engine.autotune_status(),
+        "trace": engine.tracer.stats(),
     }
 
 
@@ -353,6 +361,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, ct_doc(
                     eng, int(q.get("limit", 64)),
                     int(q["now"]) if "now" in q else None))
+            if path == "/v1/flows/metrics":
+                return self._send_json(200, {
+                    "windows": eng.flowmetrics.series(
+                        int(q.get("last", 0))),
+                    "totals": eng.flowmetrics.totals(),
+                })
+            if path == "/v1/trace":
+                return self._send_json(200, {
+                    "stats": eng.tracer.stats(),
+                    "summary": eng.tracer.summary(),
+                    "spans": eng.tracer.spans(
+                        limit=int(q.get("limit", 100)),
+                        name=q.get("name")),
+                })
             if path == "/v1/flows":
                 filters = {}
                 if "verdict" in q:
@@ -365,7 +387,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, eng.flowlog.tail(
                     int(q.get("last", 50)), **filters))
             if path == "/v1/metrics":
-                return self._send_text(200, eng.metrics.render_prometheus())
+                return self._send_text(200, eng.render_metrics())
             if path == "/v1/config":
                 import dataclasses
                 return self._send_json(200, dataclasses.asdict(eng.config))

@@ -61,6 +61,20 @@ class DaemonConfig:
     flowlog_path: str = ""         # JSONL sink ("" = in-memory ring only)
     metrics_path: str = ""         # Prometheus text file ("" = disabled)
     obs_flush_interval_s: float = 5.0
+    # --- observe/: tracing, flow metrics, autotune ---
+    trace_sample_rate: float = 0.0   # 0 off; 1/64 samples every 64th event
+    trace_capacity: int = 4096       # span ring size
+    flowmetrics_window_s: int = 10   # flow-metrics aggregation window
+    flowmetrics_windows: int = 60    # retained windows (10min at 10s)
+    flowmetrics_top_k: int = 10      # ports/identities reported per window
+    autotune_enabled: bool = False   # closed-loop pipeline tuning (opt-in)
+    autotune_interval_s: float = 5.0
+    autotune_flush_ms_min: float = 0.5
+    autotune_flush_ms_max: float = 20.0
+    autotune_target_fill: float = 0.7
+    autotune_queue_wait_p99_ms: float = 10.0   # p99 queue-wait budget
+    autotune_hysteresis: int = 3     # consecutive intervals before a step
+    autotune_step_factor: float = 1.5  # capped multiplicative step
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:
@@ -78,6 +92,25 @@ class DaemonConfig:
         if self.pipeline_inflight < 1 or self.pipeline_queue_batches < 1:
             raise ValueError(
                 "pipeline_inflight and pipeline_queue_batches must be >= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError("trace_sample_rate must be in [0, 1]")
+        if self.trace_capacity < 1:
+            raise ValueError("trace_capacity must be >= 1")
+        if self.flowmetrics_window_s < 1 or self.flowmetrics_windows < 1:
+            raise ValueError(
+                "flowmetrics_window_s and flowmetrics_windows must be >= 1")
+        if not 0 < self.autotune_flush_ms_min <= self.autotune_flush_ms_max:
+            raise ValueError(
+                "need 0 < autotune_flush_ms_min <= autotune_flush_ms_max")
+        if self.autotune_hysteresis < 1 or self.autotune_step_factor <= 1.0:
+            raise ValueError("autotune_hysteresis must be >= 1 and "
+                             "autotune_step_factor > 1")
+        if not 0.0 < self.autotune_target_fill <= 1.0:
+            raise ValueError("autotune_target_fill must be in (0, 1]")
+        if self.autotune_queue_wait_p99_ms <= 0:
+            raise ValueError("autotune_queue_wait_p99_ms must be > 0")
+        if self.autotune_interval_s <= 0:
+            raise ValueError("autotune_interval_s must be > 0")
 
     # -- sources -------------------------------------------------------------
     @classmethod

@@ -31,6 +31,7 @@ import numpy as np
 
 from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
 from cilium_tpu.compile.snapshot import PolicySnapshot
+from cilium_tpu.observe.trace import active as active_trace
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.utils import constants as C
 
@@ -268,53 +269,74 @@ class JITDatapath(DatapathBackend):
         from cilium_tpu.kernels.records import (
             PACK4_EP_SLOT_MAX, _path_words_of, pack_batch, pack_batch_l7dict,
             pack_batch_v4)
-        b = {k: np.asarray(v) for k, v in batch.items()}
-        self._wire_l7 |= bool((b["http_method"] != C.HTTP_METHOD_ANY).any()
-                              or b["http_path"].any())
-        self._wire_wide |= bool(
-            b["is_v6"].any()
-            or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
-        if self._wire_l7:
-            self._l7_path_words = max(self._l7_path_words,
-                                      _path_words_of(b["http_path"]))
-            wire, path_dict = pack_batch_l7dict(
-                b, path_words=self._l7_path_words,
-                min_rows=self._l7_dict_rows, force_full=self._wire_wide)
-            self._l7_dict_rows = max(self._l7_dict_rows, path_dict.shape[0])
-            dev_batch = (jnp.asarray(wire), jnp.asarray(path_dict))
-        elif not self._wire_wide:
-            dev_batch = jnp.asarray(pack_batch_v4(b))
-        else:
-            dev_batch = jnp.asarray(pack_batch(b))
-        with self._ct_lock:
-            out, new_ct, counters = self._classify(
-                placed, self._ct, dev_batch, jnp.uint32(now),
-                jnp.int32(snap.world_index))
-            self._ct = new_ct
+        # observe/trace: the pack/transfer/compute split attaches to the
+        # caller's current trace context (pipeline worker or
+        # Engine.classify), whichever tracer instance set it
+        tracer, trace_id = active_trace()
+        with tracer.span(trace_id, "datapath.pack"):
+            b = {k: np.asarray(v) for k, v in batch.items()}
+            self._wire_l7 |= bool(
+                (b["http_method"] != C.HTTP_METHOD_ANY).any()
+                or b["http_path"].any())
+            self._wire_wide |= bool(
+                b["is_v6"].any()
+                or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
+            path_dict = None
+            if self._wire_l7:
+                self._l7_path_words = max(self._l7_path_words,
+                                          _path_words_of(b["http_path"]))
+                wire, path_dict = pack_batch_l7dict(
+                    b, path_words=self._l7_path_words,
+                    min_rows=self._l7_dict_rows,
+                    force_full=self._wire_wide)
+                self._l7_dict_rows = max(self._l7_dict_rows,
+                                         path_dict.shape[0])
+            elif not self._wire_wide:
+                wire = pack_batch_v4(b)
+            else:
+                wire = pack_batch(b)
+        with tracer.span(trace_id, "datapath.transfer",
+                         bytes=int(wire.nbytes)):
+            if path_dict is not None:
+                dev_batch = (jnp.asarray(wire), jnp.asarray(path_dict))
+            else:
+                dev_batch = jnp.asarray(wire)
+            with self._ct_lock:
+                out, new_ct, counters = self._classify(
+                    placed, self._ct, dev_batch, jnp.uint32(now),
+                    jnp.int32(snap.world_index))
+                self._ct = new_ct
 
         def finalize():
-            out_np = {k: np.asarray(v) for k, v in out.items()}
-            counters_np = {k: np.asarray(v) for k, v in counters.items()}
+            with tracer.span(trace_id, "datapath.compute"):
+                out_np = {k: np.asarray(v) for k, v in out.items()}
+                counters_np = {k: np.asarray(v)
+                               for k, v in counters.items()}
             return out_np, counters_np
         return finalize
 
     def _classify_async_sharded(self, placed, snap, batch, now):
         from cilium_tpu.parallel.mesh import steer_batch, unsteer_outputs
         jnp = self._jnp
+        tracer, trace_id = active_trace()
         # steering must hash the post-DNAT tuple (service flows' CT entries
         # live under the translated tuple) — same translation the shim runs
         lb = snap.lb if snap.lb.n_frontends else None
-        steered, scatter, _per = steer_batch(
-            batch, self.n_flow_shards, lb=lb, round_to_pow2=True)
-        with self._ct_lock:
-            out, new_ct, counters = self._classify(
-                placed, self._ct, steered, jnp.uint32(now),
-                jnp.int32(snap.world_index))
-            self._ct = new_ct
+        with tracer.span(trace_id, "datapath.pack"):
+            steered, scatter, _per = steer_batch(
+                batch, self.n_flow_shards, lb=lb, round_to_pow2=True)
+        with tracer.span(trace_id, "datapath.transfer"):
+            with self._ct_lock:
+                out, new_ct, counters = self._classify(
+                    placed, self._ct, steered, jnp.uint32(now),
+                    jnp.int32(snap.world_index))
+                self._ct = new_ct
 
         def finalize():
-            out_np = {k: np.asarray(v) for k, v in out.items()}
-            counters_np = {k: np.asarray(v) for k, v in counters.items()}
+            with tracer.span(trace_id, "datapath.compute"):
+                out_np = {k: np.asarray(v) for k, v in out.items()}
+                counters_np = {k: np.asarray(v)
+                               for k, v in counters.items()}
             return unsteer_outputs(out_np, scatter), counters_np
         return finalize
 

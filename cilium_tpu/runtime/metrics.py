@@ -82,20 +82,30 @@ class Histogram:
         """Approximate quantile from the bucket counts (linear within the
         winning bucket). For observations past the last finite boundary the
         boundary itself is returned — a histogram cannot do better."""
-        buckets, counts, _total, count = self.snapshot()
-        if count == 0:
-            return 0.0
-        target = q * count
-        acc = 0
-        lo = 0.0
-        for i, b in enumerate(buckets):
-            if counts[i]:
-                if acc + counts[i] >= target:
-                    frac = (target - acc) / counts[i]
-                    return lo + frac * (b - lo)
-                acc += counts[i]
-            lo = b
-        return buckets[-1]
+        buckets, counts, _total, _count = self.snapshot()
+        return quantile_from(buckets, counts, q)
+
+
+def quantile_from(buckets: Sequence[float], counts: Sequence[int],
+                  q: float) -> float:
+    """Quantile over a (buckets, counts) pair — shared by
+    ``Histogram.quantile`` and consumers working on *delta* counts (the
+    observe autotuner diffs successive snapshots so each control interval
+    is judged on its own distribution, not the process lifetime's)."""
+    count = sum(counts)
+    if count == 0:
+        return 0.0
+    target = q * count
+    acc = 0
+    lo = 0.0
+    for i, b in enumerate(buckets):
+        if counts[i]:
+            if acc + counts[i] >= target:
+                frac = (target - acc) / counts[i]
+                return lo + frac * (b - lo)
+            acc += counts[i]
+        lo = b
+    return buckets[-1]
 
 
 class Metrics:

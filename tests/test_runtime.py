@@ -419,6 +419,73 @@ class TestFlowLogSinkCap:
         assert [r["src_port"] for r in tail] == list(range(7, 12))
 
 
+class TestFlowLogFollowEdges:
+    """Live-follow edge cases: the since() seq cursor across ring
+    wraparound, and tail()/since() exact-match filter typing (int vs str
+    field values must not cross-match)."""
+
+    @staticmethod
+    def _fill(log, n, start_port=0):
+        batch, out = TestFlowLogSinkCap._mk_batch_out(n)
+        batch["sport"] = np.arange(start_port, start_port + n,
+                                   dtype=np.uint32)
+        log.append_batch(batch, out, now=1, ep_ids=(1,))
+
+    def test_since_cursor_across_wraparound(self):
+        from cilium_tpu.runtime import flowlog as fl
+        log = fl.FlowLog(capacity=8, mode="all")
+        self._fill(log, 20)               # seqs 1..20; ring keeps 13..20
+        # a cursor inside the retained range follows without loss
+        got = log.since(15)
+        assert [r["seq"] for r in got] == [16, 17, 18, 19, 20]
+        # oldest-first ordering holds across the physical wrap point
+        got = log.since(0)
+        assert [r["seq"] for r in got] == list(range(13, 21))
+        # a cursor that fell off the ring resumes at the oldest retained
+        # record (records 1..12 are gone — the follower can detect the gap
+        # from the seq jump)
+        assert log.since(5)[0]["seq"] == 13
+        # cursor at the head: nothing new
+        assert log.since(20) == []
+        # limit caps oldest-first (the poll page)
+        got = log.since(0, limit=3)
+        assert [r["seq"] for r in got] == [13, 14, 15]
+
+    def test_since_filters_apply_before_limit_cursor_advances(self):
+        from cilium_tpu.runtime import flowlog as fl
+        log = fl.FlowLog(capacity=16, mode="all")
+        self._fill(log, 10)
+        got = log.since(0, src_port=7)
+        assert len(got) == 1 and got[0]["src_port"] == 7
+        # filtered follow: cursor from the last *returned* record still
+        # sees later matches only
+        assert log.since(got[0]["seq"], src_port=7) == []
+
+    def test_tail_filter_typing_int_vs_str(self):
+        from cilium_tpu.runtime import flowlog as fl
+        log = fl.FlowLog(capacity=16, mode="all")
+        self._fill(log, 6)
+        # src_port is stored as int: an int filter matches...
+        assert len(log.tail(src_port=3)) == 1
+        # ...a string of the same digits must NOT (exact typed match, the
+        # documented semantics — no coercion surprises for API callers)
+        assert log.tail(src_port="3") == []
+        # string-valued fields match strings only
+        assert len(log.tail(verdict="FORWARDED")) == 6
+        assert log.tail(verdict=True) == []
+        # unknown filter key matches nothing rather than everything
+        assert log.tail(no_such_field=1) == []
+        # combined typed filters AND together
+        assert len(log.tail(verdict="FORWARDED", src_port=3)) == 1
+
+    def test_since_typed_filters_across_wrap(self):
+        from cilium_tpu.runtime import flowlog as fl
+        log = fl.FlowLog(capacity=4, mode="all")
+        self._fill(log, 10)               # ring keeps sports 6..9
+        assert [r["src_port"] for r in log.since(0, src_port=8)] == [8]
+        assert log.since(0, src_port="8") == []
+
+
 class TestMetricsHistogram:
     def test_observe_quantile_and_render(self):
         from cilium_tpu.runtime.metrics import Histogram, Metrics
