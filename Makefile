@@ -8,7 +8,8 @@
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast chaos pipeline-smoke observe-smoke shim bench clean
+.PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
+        shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -16,15 +17,26 @@ test:
 test-fast:
 	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
 
+# Pipeline-guard gate (pipeline/guard.py): the fast, tier-1-safe stall +
+# breaker + watchdog-restart subset — deadline shed, circuit-breaker
+# open/probe/close, hang-forced restart parity, close-timeout sweep,
+# drain-vs-close races. Wired into `make chaos` below.
+chaos-pipeline:
+	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m "not slow"
+
 # Scripted fault-injection scenario (runtime/faults.py): regen failure storm
 # → last-good serving + DEGRADED, clustermesh peer flap → ipcache
-# convergence, corrupt checkpoint → cold-start fallback. Runs the scenario
-# through the real jit datapath twice: directly via the CLI (prints the
-# verdict-continuity report) and as the slow-marked pytest. A fast subset on
-# the fake datapath runs in tier-1 (tests/test_faults.py).
-chaos:
+# convergence, pipeline dispatch storm + stall-storm (watchdog restart) +
+# circuit breaker open/probe/close, corrupt checkpoint → cold-start
+# fallback. Runs the scenario through the real jit datapath twice: directly
+# via the CLI (prints the verdict-continuity report) and as the slow-marked
+# pytest, plus the slow-marked 10k-submission watchdog soak. A fast subset
+# on the fake datapath runs in tier-1 (tests/test_faults.py,
+# tests/test_pipeline_guard.py via chaos-pipeline).
+chaos: chaos-pipeline
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
+	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow
 
 # Ingestion-pipeline gate (pipeline/scheduler.py): the tier-1 pipeline
 # subset (ordering, backpressure, deadline flush, fault retries, clean

@@ -773,12 +773,15 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
             return lambda: fin()[0]
         # min_bucket == batch: every coalesced dispatch is the one
         # device-optimal shape (no trace proliferation)
+        # stall_timeout: wide — a cold-shape XLA compile or a tunnel burst
+        # must not look like a device stall to the watchdog on this rig
         return Pipeline(dispatch_fn, metrics=met, max_bucket=batch,
                         min_bucket=batch,
                         queue_batches=max(64, cfg.pipeline_queue_batches),
                         admission="block", block_timeout_s=60.0,
                         flush_ms=cfg.pipeline_flush_ms,
-                        inflight=cfg.pipeline_inflight)
+                        inflight=cfg.pipeline_inflight,
+                        stall_timeout_s=300.0)
 
     met = Metrics()
     pl = make_pipeline(met)        # long-lived, like a serving daemon's
@@ -832,6 +835,15 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
         if bl else 0.0,
         "fill_ratio": stats["fill_ratio_avg"],
         "flush_reasons": stats["flush_reasons"],
+        # guard-layer counters: overload/degradation behavior belongs in
+        # the artifact (a healthy run shows zeros; a shedding or
+        # breaker-tripping run is visibly not a clean number)
+        "shed_total": stats.get("shed_total", 0),
+        "shed_reasons": stats.get("shed_reasons", {}),
+        "admission_drops": stats.get("admission_drops", 0),
+        "breaker": stats.get("breaker", {}),
+        "restarts": stats.get("restarts", 0),
+        "pipeline_state": stats.get("state", "ok"),
         "inflight": cfg.pipeline_inflight,
         "ingest_chunk": chunk,
         "windows": windows,

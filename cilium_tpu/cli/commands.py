@@ -135,6 +135,27 @@ def register(sub: "argparse._SubParsersAction") -> None:
     p.set_defaults(func=_cmd_trace)
 
     p = sub.add_parser(
+        "classify", help="serve one flow through a live agent's ingestion "
+                         "pipeline (POST /v1/classify; the serving path "
+                         "with guard semantics: 429 on overload shed, 503 "
+                         "on breaker-open/hard-failed/timeout)")
+    p.add_argument("--api", metavar="SOCKET", required=True)
+    p.add_argument("--ep", type=int, required=True, help="local endpoint id")
+    p.add_argument("--remote", required=True, help="remote IP")
+    p.add_argument("--dport", type=int, required=True)
+    p.add_argument("--sport", type=int, default=0)
+    p.add_argument("--proto", default="TCP")
+    p.add_argument("--direction", choices=["egress", "ingress"],
+                   default="egress")
+    p.add_argument("--src", help="source IP (default: the endpoint's "
+                                 "first IP — required if it has none)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-submission staleness bound (shed past it)")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="text")
+    p.set_defaults(func=_cmd_classify)
+
+    p = sub.add_parser(
         "verify", help="compile every datapath config combo and check the "
                        "memory budget (XLA-as-verifier; the test/verifier "
                        "CI-step analog)")
@@ -170,8 +191,10 @@ def register(sub: "argparse._SubParsersAction") -> None:
     fc = fsub.add_parser(
         "chaos", help="run the scripted chaos scenario and print the "
                       "verdict-continuity report (exit 1 on any classify "
-                      "error or missed recovery). In-process mode runs all "
-                      "four phases (regen storm/recovery, peer flap, "
+                      "error or missed recovery). In-process mode runs "
+                      "every phase (regen storm/recovery, peer flap, "
+                      "pipeline dispatch storm, stall-storm watchdog "
+                      "restart, circuit breaker open/probe/close, "
                       "checkpoint corruption); --api mode runs the regen "
                       "storm + recovery against the live agent only")
     fc.add_argument("--api", metavar="SOCKET",
@@ -276,7 +299,12 @@ def _cmd_status(args) -> int:
         pl = d.get("pipeline")
         if pl:
             fl = pl.get("flush_reasons", {})
+            br = pl.get("breaker") or {}
             print("Pipeline:")
+            print(f"  state:          {pl.get('state', 'ok')}"
+                  f" (breaker {br.get('state', 'closed')},"
+                  f" restarts {pl.get('restarts', 0)}"
+                  f"/{pl.get('max_restarts', '-')})")
             print(f"  queue depth:    {pl.get('queue_depth')}"
                   f" (inflight {pl.get('inflight')},"
                   f" staged rows {pl.get('staged_rows')})")
@@ -290,6 +318,11 @@ def _cmd_status(args) -> int:
             print(f"  drops/faults:   {pl.get('admission_drops')} admission,"
                   f" {pl.get('dispatch_faults')} dispatch faults,"
                   f" {pl.get('dispatch_errors')} errors")
+            shed = pl.get("shed_reasons") or {}
+            if pl.get("shed_total") or pl.get("unavailable_total"):
+                print(f"  shed:           {pl.get('shed_total', 0)} deadline ("
+                      + " ".join(f"{k}={v}" for k, v in sorted(shed.items()))
+                      + f"), {pl.get('unavailable_total', 0)} unavailable")
         at = d.get("autotune")
         if at:
             print(f"Autotune:         flush_ms={at.get('flush_ms')}"
@@ -737,6 +770,52 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_classify(args) -> int:
+    """The CLI serving path. Exit codes mirror the guard taxonomy: 0
+    served, 2 overload shed (retry), 3 unavailable (back off), 1 other."""
+    from cilium_tpu.runtime.api import UnixAPIClient
+    src = args.src
+    if src is None:
+        status, ep = UnixAPIClient(args.api).get(f"/v1/endpoints/{args.ep}")
+        if status != 200:
+            print(f"API error {status}: {ep}", file=sys.stderr)
+            return 1
+        if not ep.get("ips"):
+            print(f"endpoint {args.ep} has no IPs; pass --src",
+                  file=sys.stderr)
+            return 1
+        src = ep["ips"][0]
+    body = {"records": [{
+        "src": src, "dst": args.remote, "sport": args.sport,
+        "dport": args.dport, "proto": args.proto, "ep": args.ep,
+        "direction": args.direction}]}
+    if args.deadline_ms is not None:
+        body["deadline_ms"] = args.deadline_ms
+    status, doc = UnixAPIClient(args.api).post("/v1/classify", body)
+    if args.output == "json":
+        print(json.dumps({"status": status, **(doc if isinstance(doc, dict)
+                                               else {"body": doc})},
+                         indent=2, default=str))
+    elif status == 200:
+        v = doc["verdicts"][0]
+        mark = "ALLOWED" if v["allow"] else "DENIED"
+        print(f"{mark} {src}:{args.sport} -> {args.remote}:{args.dport} "
+              f"({args.proto} {args.direction}) reason={v['reason']} "
+              f"ct={v['ct_state']} remote_id={v['remote_identity']}")
+    else:
+        kind = doc.get("kind", "") if isinstance(doc, dict) else ""
+        print(f"serving error {status} {kind}: "
+              f"{doc.get('error', doc) if isinstance(doc, dict) else doc}",
+              file=sys.stderr)
+    if status == 200:
+        return 0
+    if status == 429:
+        return 2
+    if status == 503:
+        return 3
+    return 1
+
+
 def _cmd_verify(args) -> int:
     from cilium_tpu.compile.verifier import verify_configs
     reports = verify_configs(batch=args.batch,
@@ -863,7 +942,14 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
     FAULTS.reset()
 
     def mk_engine():
-        cfg = DaemonConfig(ct_capacity=4096, auto_regen=False)
+        # guard knobs sized for the drill: quick breaker cooldown and
+        # restart backoff; the stall timeout stays wide here (first
+        # dispatches JIT-compile) and is shrunk at runtime for the
+        # stall-storm phase, after the shapes are warm
+        cfg = DaemonConfig(ct_capacity=4096, auto_regen=False,
+                           pipeline_breaker_cooldown_s=0.4,
+                           pipeline_max_restarts=5,
+                           pipeline_restart_backoff_s=0.05)
         dp = None
         if datapath_kind == "fake":
             from cilium_tpu.runtime.datapath import FakeDatapath
@@ -987,6 +1073,104 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
         f"{n_sub} pipelined submissions at 50% dispatch faults: "
         f"{pstats.get('dispatch_faults', 0)} trips retried, {pl_errors} "
         f"errors, {pl_divergences} verdict divergences, drained={drained}")
+
+    # -- phase 3.6: stall-storm → watchdog-supervised restart ---------------
+    # a hang-mode fault wedges the worker inside dispatch (the device-stall
+    # simulation); the watchdog must reject the wedged window, restart the
+    # worker, and keep serving — post-restart verdicts bit-identical to
+    # baseline, no ticket blocked forever
+    pl = eng.start_pipeline()
+    pl.set_stall_timeout_s(0.75)         # shapes are warm; stall fast
+    FAULTS.arm("pipeline.dispatch", mode="hang", delay_s=4.0, times=1)
+    tickets = [eng.submit(mk_batch(slot_of), now=500 + i) for i in range(8)]
+    drained = eng.drain(timeout=30)
+    FAULTS.disarm("pipeline.dispatch")   # release the fenced-off worker
+    st_rejected = st_divergences = st_unresolved = 0
+    for t in tickets:
+        if not t.done():
+            st_unresolved += 1
+            continue
+        try:
+            out = t.result(timeout=1)
+        except Exception:
+            st_rejected += 1
+            continue
+        if [bool(a) for a in out["allow"]] != baseline:
+            st_divergences += 1
+    # post-restart serving: the fresh worker must answer bit-identical to
+    # the serial baseline (give the restart backoff a moment to finish)
+    import time as _t
+    for _ in range(40):
+        if (eng.pipeline_stats() or {}).get("state") == "ok":
+            break
+        _t.sleep(0.05)
+    post_ok = 0
+    for i in range(3):
+        try:
+            out = eng.submit(mk_batch(slot_of), now=550 + i).result(
+                timeout=20)
+            post_ok += [bool(a) for a in out["allow"]] == baseline
+        except Exception:
+            pass
+    pstats = eng.pipeline_stats() or {}
+    pl.set_stall_timeout_s(30.0)
+    report.record(
+        "stall-storm",
+        drained and st_unresolved == 0 and st_rejected >= 1
+        and st_divergences == 0 and pstats.get("restarts", 0) >= 1
+        and post_ok == 3 and pstats.get("state") == "ok",
+        f"hang-wedged dispatch: {pstats.get('restarts', 0)} watchdog "
+        f"restart(s), {st_rejected} wedged tickets rejected, "
+        f"{st_unresolved} stuck, {st_divergences} divergences, "
+        f"{post_ok}/3 post-restart submissions matched baseline, "
+        f"state={pstats.get('state')}")
+
+    # -- phase 3.7: circuit breaker open → half-open probe → close ----------
+    # fail-always dispatch: the first submission burns at most `threshold`
+    # attempts before the breaker opens; subsequent submissions fail fast
+    # (no retry burn); disarming + cooldown lets the half-open probe close
+    # the breaker and serving resumes bit-identical
+    from cilium_tpu.pipeline import PipelineUnavailable
+    FAULTS.arm("pipeline.dispatch", mode="fail")
+    faults_before = (eng.pipeline_stats() or {}).get("dispatch_faults", 0)
+    first = eng.submit(mk_batch(slot_of), now=600)
+    first_rejected = False
+    try:
+        first.result(timeout=20)
+    except PipelineUnavailable:
+        first_rejected = True
+    except Exception:
+        pass
+    fast_fails = 0
+    for i in range(3):                   # breaker open → instant rejection
+        try:
+            eng.submit(mk_batch(slot_of), now=601 + i)
+        except PipelineUnavailable:
+            fast_fails += 1
+    pstats = eng.pipeline_stats() or {}
+    opened = pstats.get("breaker", {}).get("state") == "open"
+    burned = pstats.get("dispatch_faults", 0) - faults_before
+    h_open = eng.health()
+    FAULTS.disarm("pipeline.dispatch")
+    _t.sleep(0.5)                        # past the 0.4s cooldown
+    probe_ok = False
+    try:
+        out = eng.submit(mk_batch(slot_of), now=610).result(timeout=20)
+        probe_ok = [bool(a) for a in out["allow"]] == baseline
+    except Exception:
+        pass
+    pstats = eng.pipeline_stats() or {}
+    report.record(
+        "breaker",
+        first_rejected and fast_fails == 3 and opened
+        and burned <= eng.config.pipeline_breaker_threshold + 1
+        and h_open["state"] != C.HEALTH_OK
+        and probe_ok and pstats.get("breaker", {}).get("state") == "closed"
+        and pstats.get("state") == "ok",
+        f"fail-always dispatch: opened after {burned} attempts (cap "
+        f"{eng.config.pipeline_breaker_threshold}), {fast_fails}/3 fast "
+        f"fails, health={h_open['state']}, probe closed breaker and "
+        f"matched baseline={probe_ok}")
 
     # -- phase 4: checkpoint torn write + corruption fallback ---------------
     state = tempfile.mkdtemp(prefix="cilium-tpu-chaos-ckpt-")

@@ -1,11 +1,16 @@
 """Pipelined ingestion scheduler: overlapped host→device batching runtime.
 
-See :mod:`cilium_tpu.pipeline.scheduler` for the design.
+See :mod:`cilium_tpu.pipeline.scheduler` for the design and
+:mod:`cilium_tpu.pipeline.guard` for the overload-protection/self-healing
+layer (deadlines, circuit breaker, watchdog-supervised restart).
 """
 
-from cilium_tpu.pipeline.scheduler import (Pipeline, PipelineClosed,
-                                           PipelineDrop, PipelineError,
-                                           Ticket)
+from cilium_tpu.pipeline.guard import (CircuitBreaker, PipelineClosed,
+                                       PipelineDeadlineExceeded,
+                                       PipelineDrop, PipelineError,
+                                       PipelineUnavailable, Watchdog)
+from cilium_tpu.pipeline.scheduler import Pipeline, Ticket
 
-__all__ = ["Pipeline", "PipelineClosed", "PipelineDrop", "PipelineError",
-           "Ticket"]
+__all__ = ["CircuitBreaker", "Pipeline", "PipelineClosed",
+           "PipelineDeadlineExceeded", "PipelineDrop", "PipelineError",
+           "PipelineUnavailable", "Ticket", "Watchdog"]

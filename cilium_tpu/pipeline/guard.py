@@ -1,0 +1,280 @@
+"""Pipeline guard layer: overload protection + self-healing for the hot path.
+
+PR 1 gave the *control plane* supervised degradation (last-good snapshots,
+backoff, OK/DEGRADED/STALE health) and the scheduler gave the pipeline
+retry-on-fault — but until this layer the serving path still failed
+unboundedly: a hung ``dispatch_fn``/``finalize`` (device stall) wedged the
+worker forever with every ticket blocked, a worker crash closed the
+pipeline permanently, admitted work had no deadline so a backlog served
+arbitrarily stale submissions, and repeated dispatch errors kept hammering
+a sick backend. This module holds the three mechanisms the scheduler wires
+into its hot path to extend the supervised-degradation philosophy there:
+
+- **Error taxonomy** — every way a submission can fail is a distinct
+  ``PipelineError`` subclass, so the serving surface (REST/CLI) can map
+  overload shed (:class:`PipelineDrop`, :class:`PipelineDeadlineExceeded`
+  → 429) apart from unavailability (:class:`PipelineUnavailable`,
+  :class:`PipelineClosed` → 503).
+- :class:`CircuitBreaker` — consecutive dispatch/finalize failures past a
+  threshold open the breaker; submissions then fail fast with
+  :class:`PipelineUnavailable` instead of burning per-submission retry
+  budgets against a sick backend. After ``cooldown_s`` one *probe*
+  submission is admitted (half-open); its dispatch succeeding closes the
+  breaker, failing re-opens it. Transitions are traced
+  (``pipeline.breaker`` events), counted
+  (``pipeline_breaker_transitions_total{to=...}``) and gauged
+  (``pipeline_breaker_state``).
+- :class:`Watchdog` — a supervisor thread fed by worker heartbeats (armed
+  around each blocking dispatch/finalize call). A heartbeat armed longer
+  than ``stall_timeout_s`` means the worker is wedged in the device path;
+  the watchdog then drives the scheduler's restart protocol: reject the
+  wedged in-flight window, abandon the stuck thread behind a generation
+  fence, and start a fresh worker on a fresh staging ring. Restarts are
+  bounded with capped backoff; past the bound the pipeline goes
+  *hard-failed* (every submission rejected fast) rather than flapping.
+
+The scheduler (``pipeline/scheduler.py``) owns the wiring; everything here
+is mechanism, deliberately free of scheduler imports so the error types
+can be shared across layers (engine, API, CLI) without cycles.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger("cilium_tpu.pipeline.guard")
+
+#: pipeline serving states surfaced through stats()/health()/Prometheus
+#: (gauge ``pipeline_state`` carries the numeric code)
+PIPELINE_STATES: Dict[str, int] = {
+    "ok": 0, "breaker-open": 1, "restarting": 2, "failed": 3, "closed": 4,
+}
+
+#: breaker states → ``pipeline_breaker_state`` gauge codes
+BREAKER_STATES: Dict[str, int] = {"closed": 0, "half-open": 1, "open": 2}
+
+
+class PipelineError(RuntimeError):
+    """Base error for pipeline submissions."""
+
+
+class PipelineDrop(PipelineError):
+    """Submission shed at admission (queue full, drop mode or block
+    timeout exhausted). Overload shed → retryable (429 at the API)."""
+
+
+class PipelineClosed(PipelineError):
+    """submit() after close()/stop()."""
+
+
+class PipelineDeadlineExceeded(PipelineError):
+    """Submission shed because its deadline passed before the worker
+    reached it (at ingest) or before its microbatch dispatched (at
+    flush). The answer nobody is waiting for is never computed."""
+
+
+class PipelineUnavailable(PipelineError):
+    """Fail-fast rejection: the circuit breaker is open, or the pipeline
+    hard-failed after exhausting its watchdog restart budget. 503 at the
+    API — the backend is sick, not merely busy."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the dispatch path.
+
+    Thread-safe and self-contained: the scheduler calls
+    :meth:`record_failure` / :meth:`record_success` from the worker and
+    :meth:`admit` from producers; ``on_transition`` (if given) fires on
+    every state change with ``(old, new)`` so the owner can fold the state
+    into its own health surface."""
+
+    def __init__(self, threshold: int = 20, cooldown_s: float = 5.0, *,
+                 metrics=None, tracer=None, name: str = "pipeline",
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        if cooldown_s <= 0:
+            raise ValueError("breaker cooldown must be > 0")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.metrics = metrics
+        self.tracer = tracer
+        self.name = name
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_mono = 0.0
+        self._probe_at: Optional[float] = None   # a half-open probe is out
+        self._transitions = 0
+
+    # -- producer side -------------------------------------------------------
+    def admit(self) -> bool:
+        """One admission decision. ``True`` → let the submission in
+        (normal serving, or the half-open probe); ``False`` → fail fast."""
+        moved = None
+        with self._lock:
+            now = time.monotonic()
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if now - self._opened_mono >= self.cooldown_s:
+                    moved = self._transition_locked("half-open")
+                    self._probe_at = now
+                    verdict = True
+                else:
+                    verdict = False
+            # half-open: one probe at a time; a probe that never reported
+            # back (admission dropped it downstream) expires after a
+            # cooldown so the breaker cannot wedge itself shut
+            elif self._probe_at is None or now - self._probe_at >= \
+                    self.cooldown_s:
+                self._probe_at = now
+                verdict = True
+            else:
+                verdict = False
+        self._emit(moved)
+        return verdict
+
+    # -- worker side ---------------------------------------------------------
+    def record_failure(self) -> bool:
+        """One dispatch/finalize failure. Returns True when the breaker is
+        now open (the caller should stop retrying and reject fast)."""
+        moved = None
+        with self._lock:
+            self._consecutive += 1
+            self._probe_at = None
+            if self._state == "half-open":
+                moved = self._transition_locked("open")   # the probe failed
+                self._opened_mono = time.monotonic()
+            elif self._state == "closed" and \
+                    self._consecutive >= self.threshold:
+                moved = self._transition_locked("open")
+                self._opened_mono = time.monotonic()
+            now_open = self._state == "open"
+        self._emit(moved)
+        return now_open
+
+    def record_success(self) -> None:
+        moved = None
+        with self._lock:
+            self._consecutive = 0
+            self._probe_at = None
+            if self._state != "closed":
+                # the probe came back healthy
+                moved = self._transition_locked("closed")
+        self._emit(moved)
+
+    # -- internals -----------------------------------------------------------
+    def _transition_locked(self, to: str) -> Tuple[str, str, int]:
+        """Lock held: flip the state; the observable side effects happen
+        in :meth:`_emit` after the lock is released (``on_transition`` may
+        take the owner's lock — holding ours across it would invert lock
+        order against readers of :attr:`state`)."""
+        old, self._state = self._state, to
+        self._transitions += 1
+        return (old, to, self._consecutive)
+
+    def _emit(self, moved: Optional[Tuple[str, str, int]]) -> None:
+        if moved is None:
+            return
+        old, to, consecutive = moved
+        log.warning("%s circuit breaker %s -> %s (%d consecutive failures)",
+                    self.name, old, to, consecutive)
+        if self.metrics is not None:
+            self.metrics.inc_counter(
+                f'pipeline_breaker_transitions_total{{to="{to}"}}')
+            self.metrics.set_gauge("pipeline_breaker_state",
+                                   BREAKER_STATES[to])
+        if self.tracer is not None:
+            self.tracer.event("pipeline.breaker", frm=old, to=to,
+                              consecutive=consecutive)
+        if self._on_transition is not None:
+            self._on_transition(old, to)
+
+    # -- read side -----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def stats(self) -> Dict:
+        with self._lock:
+            d = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "transitions": self._transitions,
+            }
+            if self._state == "open":
+                d["retry_in_s"] = round(max(
+                    0.0, self.cooldown_s
+                    - (time.monotonic() - self._opened_mono)), 3)
+            return d
+
+
+class Watchdog:
+    """Supervisor thread watching the worker's heartbeat.
+
+    ``heartbeat()`` returns the worker's currently armed beat as
+    ``(armed_mono, label, gen, grace)`` or None when the worker is not
+    inside a blocking call (an idle worker parked on its condvar is
+    healthy, not stalled). ``grace`` is a per-beat multiplier on the stall
+    budget — a cold first dispatch (XLA compile) gets more rope than a
+    warm one. When a beat stays armed past ``stall_timeout_s × grace``
+    the watchdog calls ``on_stall(gen, reason)`` — the scheduler's
+    restart protocol, which is generation-fenced so a double fire is a
+    no-op.
+    ``should_stop()`` True ends the thread (pipeline closed/hard-failed).
+
+    ``stall_timeout_s`` is mutable at runtime (the chaos driver shrinks it
+    after XLA warmup so a stall-storm scenario doesn't have to out-wait a
+    production-sized timeout)."""
+
+    def __init__(self, *, stall_timeout_s: float,
+                 heartbeat: Callable[
+                     [], Optional[Tuple[float, str, int, int]]],
+                 on_stall: Callable[[int, str], None],
+                 should_stop: Callable[[], bool],
+                 name: str = "pipeline"):
+        if stall_timeout_s <= 0:
+            raise ValueError("stall_timeout_s must be > 0")
+        self.stall_timeout_s = stall_timeout_s
+        self._heartbeat = heartbeat
+        self._on_stall = on_stall
+        self._should_stop = should_stop
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-watchdog")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while True:
+            # re-derive each lap: stall_timeout_s is runtime-tunable
+            time.sleep(max(0.005, min(self.stall_timeout_s / 4.0, 0.25)))
+            if self._should_stop():
+                return
+            beat = self._heartbeat()
+            if beat is None:
+                continue
+            armed_mono, label, gen, grace = beat
+            budget = self.stall_timeout_s * max(1, grace)
+            stalled_for = time.monotonic() - armed_mono
+            if stalled_for > budget:
+                try:
+                    self._on_stall(gen, f"worker stalled in {label} for "
+                                        f"{stalled_for:.2f}s (timeout "
+                                        f"{budget}s)")
+                except Exception:        # noqa: BLE001 — never kill the dog
+                    log.exception("watchdog restart attempt failed")

@@ -32,12 +32,41 @@ and the datapath that closes that shape problem:
   reasons, fill ratio, and ``pipeline_queue_wait_seconds`` /
   ``pipeline_batch_latency_seconds`` histograms through ``Metrics``.
 
-Fault injection: every dispatch fires the ``pipeline.dispatch`` point.
-``FaultInjected`` trips are retried with a capped backoff (counted in
-``pipeline_dispatch_faults_total``) — an armed chaos scenario delays
-batches but never loses or reorders them. Non-fault dispatch errors reject
-only the affected tickets; the pipeline keeps serving (supervised
-degradation, same philosophy as the engine's regen path).
+Overload protection & self-healing (the guard layer, ``pipeline/guard.py``):
+
+- **Per-submission deadlines**: ``submit(deadline_ms=...)`` rides the
+  ticket; the worker sheds already-stale work at ingest and at flush time
+  (rejected with :class:`PipelineDeadlineExceeded`, counted per reason in
+  ``pipeline_shed_total{reason}``) so a backlog never burns device time on
+  answers nobody is waiting for.
+- **Circuit breaker**: consecutive dispatch/finalize failures past
+  ``breaker_threshold`` open the breaker — submissions fail fast with
+  :class:`PipelineUnavailable` instead of burning the per-submission retry
+  cap against a sick backend; after ``breaker_cooldown_s`` a half-open
+  probe dispatch closes it again.
+- **Watchdog-supervised restart**: worker heartbeats are armed around each
+  blocking dispatch/finalize call; a beat armed past ``stall_timeout_s``
+  (device stall) — or a worker crash — triggers the restart protocol: the
+  wedged in-flight window is rejected, the stuck thread is abandoned
+  behind a generation fence (it can never touch live state again), and a
+  fresh worker starts on a fresh staging ring. Queued-but-uningested
+  submissions survive a restart, preserving the FIFO/bit-identical
+  contract for everything that still resolves. Restarts are bounded with
+  capped backoff; past ``max_restarts`` the pipeline goes *hard-failed*
+  and every submission is rejected fast.
+- **State**: ``stats()["state"]`` ∈ ok / breaker-open / restarting /
+  failed / closed folds into ``Engine.health()``, ``healthz`` and the
+  ``pipeline_state`` gauge.
+
+Fault injection: every dispatch fires the ``pipeline.dispatch`` point and
+every finalize fires ``pipeline.finalize``. ``FaultInjected`` dispatch
+trips are retried with a capped backoff (counted in
+``pipeline_dispatch_faults_total``) until the breaker opens — an armed
+chaos scenario delays batches but never loses or reorders them. Non-fault
+dispatch errors reject only the affected tickets; the pipeline keeps
+serving (supervised degradation, same philosophy as the engine's regen
+path). The ``hang`` fault mode stalls cooperatively inside the point —
+the scenario ``make chaos`` uses to force a watchdog restart.
 """
 
 from __future__ import annotations
@@ -52,15 +81,36 @@ import numpy as np
 
 from cilium_tpu.kernels.records import empty_batch
 from cilium_tpu.observe.trace import TRACER, Tracer
+from cilium_tpu.pipeline.guard import (PIPELINE_STATES, CircuitBreaker,
+                                       PipelineClosed,
+                                       PipelineDeadlineExceeded,
+                                       PipelineDrop, PipelineError,
+                                       PipelineUnavailable, Watchdog)
 from cilium_tpu.runtime.faults import FAULTS, FaultInjected
 from cilium_tpu.runtime.metrics import Metrics
 
 log = logging.getLogger("cilium_tpu.pipeline")
 
 #: retry caps for FaultInjected dispatch trips (the closing cap bounds
-#: shutdown time when a fail-always fault is armed)
+#: shutdown time when a fail-always fault is armed; the breaker usually
+#: opens long before either cap is reached)
 MAX_DISPATCH_RETRIES = 1000
 MAX_DISPATCH_RETRIES_CLOSING = 25
+
+#: backoff cap between watchdog restarts (seconds)
+MAX_RESTART_BACKOFF_S = 5.0
+
+#: the restart budget is a flap-stopper, not a lifetime kill switch: after
+#: this long without a restart the spent budget is forgiven, so isolated
+#: stalls weeks apart on a long-lived daemon never accumulate into a
+#: hard-fail — only `max_restarts` restarts *within one window* do
+RESTART_BUDGET_WINDOW_S = 300.0
+
+#: the first dispatch of a worker generation may run a cold-shape XLA
+#: compile inside dispatch_fn — give its heartbeat this multiple of the
+#: stall timeout before the watchdog calls it a device stall, so a healthy
+#: daemon's warmup can never restart-loop into hard-fail
+COLD_DISPATCH_GRACE = 4
 
 # canonical out columns (the DatapathBackend.classify contract) — used to
 # resolve all-invalid submissions without a device round trip
@@ -81,17 +131,12 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
-class PipelineError(RuntimeError):
-    """Base error for pipeline submissions."""
-
-
-class PipelineDrop(PipelineError):
-    """Submission shed at admission (queue full, drop mode or block
-    timeout exhausted)."""
-
-
-class PipelineClosed(PipelineError):
-    """submit() after close()."""
+class _Superseded(BaseException):
+    """Internal unwind signal: this worker's generation was replaced (the
+    watchdog restarted the pipeline around it, or close() fenced it off).
+    A BaseException so the supervised ``except Exception`` paths in the
+    worker cannot swallow it; ``_run`` catches it and exits silently —
+    the replacement already owns all state, nothing to clean up."""
 
 
 class Ticket:
@@ -101,7 +146,7 @@ class Ticket:
     the serial classify path)."""
 
     __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono", "trace_id",
-                 "_event", "_out", "_exc")
+                 "deadline_mono", "_event", "_out", "_exc")
 
     def __init__(self, n_rows: int, n_valid: int):
         self.seq = -1                      # assigned at admission
@@ -109,6 +154,7 @@ class Ticket:
         self.n_valid = n_valid
         self.trace_id = None               # observe/trace sampling decision
         self.submitted_mono = time.monotonic()
+        self.deadline_mono: Optional[float] = None   # shed-after fence
         self._event = threading.Event()
         self._out: Optional[Dict[str, np.ndarray]] = None
         self._exc: Optional[BaseException] = None
@@ -183,7 +229,8 @@ class Pipeline:
 
     Producers call :meth:`submit` from any thread; one worker thread owns
     staging, dispatch, and finalization, which is what guarantees CT-order
-    == submission-order."""
+    == submission-order. A watchdog thread supervises the worker (see the
+    module docstring's guard-layer section)."""
 
     def __init__(self, dispatch_fn: Callable, *,
                  metrics: Optional[Metrics] = None,
@@ -191,7 +238,13 @@ class Pipeline:
                  queue_batches: int = 64, admission: str = "block",
                  block_timeout_s: float = 1.0, flush_ms: float = 2.0,
                  inflight: int = 2, name: str = "pipeline",
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 deadline_ms: float = 0.0,
+                 breaker_threshold: int = 20,
+                 breaker_cooldown_s: float = 5.0,
+                 stall_timeout_s: float = 30.0,
+                 max_restarts: int = 3,
+                 restart_backoff_s: float = 0.2):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
@@ -201,6 +254,11 @@ class Pipeline:
             raise ValueError(f"bad admission mode {admission!r}")
         if inflight < 1 or queue_batches < 1:
             raise ValueError("inflight and queue_batches must be >= 1")
+        if deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0 (0 = no deadline)")
+        if max_restarts < 0 or restart_backoff_s <= 0:
+            raise ValueError("max_restarts must be >= 0 and "
+                             "restart_backoff_s > 0")
         self._dispatch_fn = dispatch_fn
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else TRACER
@@ -211,6 +269,8 @@ class Pipeline:
         self._block_timeout_s = block_timeout_s
         self._flush_s = flush_ms / 1e3
         self._inflight_max = inflight
+        self._default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
+        self._name = name
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -220,6 +280,19 @@ class Pipeline:
         self._closing = False
         self._closed = False
         self._next_seq = 0
+
+        # guard state (generation fence + restart budget)
+        self._gen = 0                    # current worker generation
+        self._worker_gen = 0             # generation self._worker runs
+        self._restarts = 0
+        self._last_restart_mono = 0.0
+        self._max_restarts = max_restarts
+        self._restart_backoff_s = restart_backoff_s
+        self._restarting = False
+        self._failed = False             # hard-failed: restart budget spent
+        self._cold_dispatch = True       # this gen has not dispatched yet
+        #: armed heartbeat: (armed_mono, label, gen, stall multiplier)
+        self._hb: Optional[Tuple[float, str, int, int]] = None
 
         # worker-owned (no lock): staging ring + inflight window
         self._buffers = [empty_batch(max_bucket)
@@ -232,31 +305,60 @@ class Pipeline:
         self._stage_now: Optional[int] = None
         self._inflight: deque = deque()
         self._current: Optional[_Sub] = None   # popped, mid-_ingest
+        self._dispatching: List[_Slice] = []   # handed to _dispatch, not
+        self._finalizing: Optional[_Inflight] = None   # ... yet inflight
 
-        # stats (worker-owned except drops/submitted)
+        # stats. submitted/admission_drops/shed mutate under self._lock;
+        # the worker-owned counters are mirrored into the _pub snapshot
+        # (also under the lock) so stats() never does a cross-thread
+        # unsynchronized read of in-flux worker state
         self.submitted = 0
         self.admission_drops = 0
         self.dispatched_batches = 0
         self.completed_batches = 0
         self.dispatch_faults = 0
         self.dispatch_errors = 0
+        self.shed_total = 0
+        self.shed_reasons: Dict[str, int] = {}
+        self.unavailable_total = 0
         self.flush_reasons: Dict[str, int] = {
             "direct": 0, "full": 0, "deadline": 0, "drain": 0}
         self._fill_rows = 0
         self._bucket_rows = 0
+        self._pub: Dict = {}             # worker-published stats snapshot
 
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name=f"{name}-worker")
+        self.breaker = CircuitBreaker(
+            breaker_threshold, breaker_cooldown_s, metrics=self.metrics,
+            tracer=self.tracer, name=name,
+            on_transition=self._on_breaker_transition)
+        self._watchdog = Watchdog(
+            stall_timeout_s=stall_timeout_s,
+            heartbeat=lambda: self._hb,
+            on_stall=self._restart_worker,
+            should_stop=lambda: self._closed or self._failed,
+            name=name)
+
+        self._worker = threading.Thread(target=self._run, args=(0,),
+                                        daemon=True, name=f"{name}-worker")
         self._worker.start()
+        self._watchdog.start()
 
     # -- producer side -------------------------------------------------------
     def submit(self, batch: Dict[str, np.ndarray],
                now: Optional[int] = None,
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Ticket:
         """Admit one batch (records layout, ``valid``-masked). Returns a
         :class:`Ticket` immediately; with ``admission="drop"`` (or a blocked
         admission that times out) the ticket comes back already rejected
         with :class:`PipelineDrop` — check ``ticket.dropped``.
+
+        ``deadline_ms`` (default: the pipeline-wide ``deadline_ms``, 0 =
+        none) bounds how stale this submission may get: work the worker
+        cannot reach/dispatch before the deadline is shed with
+        :class:`PipelineDeadlineExceeded` instead of burning device time.
+        Raises :class:`PipelineUnavailable` (fail fast, no queueing) while
+        the circuit breaker is open or the pipeline is hard-failed.
 
         The caller must not mutate ``batch`` until the ticket resolves (the
         staging copy happens on the worker; a direct-dispatch batch is read
@@ -267,7 +369,21 @@ class Pipeline:
             raise ValueError(
                 f"submission has {n_valid} valid rows > max_bucket "
                 f"{self._max_bucket}; split it or raise batch_size")
+        if self._failed:
+            self._count_unavailable()
+            raise PipelineUnavailable(
+                f"pipeline hard-failed after {self._restarts} worker "
+                "restarts; no new submissions")
+        if not self.breaker.admit():
+            self._count_unavailable()
+            raise PipelineUnavailable(
+                "circuit breaker open after consecutive dispatch failures; "
+                f"retry in {self.breaker.stats().get('retry_in_s', 0.0)}s")
         ticket = Ticket(n_rows=int(valid.shape[0]), n_valid=n_valid)
+        dl = self._default_deadline_s if deadline_ms is None \
+            else (deadline_ms / 1e3 if deadline_ms > 0 else None)
+        if dl is not None:
+            ticket.deadline_mono = ticket.submitted_mono + dl
         # the sampling decision is made once per submission and rides the
         # ticket; unsampled submissions pay exactly one counter draw here
         ticket.trace_id = self.tracer.maybe_sample()
@@ -276,6 +392,14 @@ class Pipeline:
         with self._lock:
             if self._closing or self._closed:
                 raise PipelineClosed("pipeline is closed")
+            if self._failed:
+                # re-check under the lock: a hard-fail landing between the
+                # unlocked check above and here must not enqueue a ticket
+                # nothing will ever serve
+                self._count_unavailable_locked()
+                raise PipelineUnavailable(
+                    f"pipeline hard-failed after {self._restarts} worker "
+                    "restarts; no new submissions")
             while len(self._queue) >= self._queue_max:
                 remaining = deadline - time.monotonic()
                 if self._admission == "drop" or remaining <= 0:
@@ -289,6 +413,12 @@ class Pipeline:
                 if self._closing or self._closed:
                     raise PipelineClosed("pipeline closed while blocked "
                                          "at admission")
+                if self._failed:
+                    # hard-fail swept the queue out from under us; the
+                    # freed capacity must not admit work nothing will serve
+                    self._count_unavailable_locked()
+                    raise PipelineUnavailable(
+                        "pipeline hard-failed while blocked at admission")
             ticket.seq = self._next_seq
             self._next_seq += 1
             self._queue.append(_Sub(ticket, batch, now))
@@ -321,20 +451,60 @@ class Pipeline:
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Clean shutdown: stop admitting, process everything already
-        queued/staged/in flight, then stop the worker. Idempotent."""
+        queued/staged/in flight, then stop the worker. If the worker does
+        not stop within ``timeout`` (wedged in a device call) it is fenced
+        off behind a generation bump and every outstanding ticket is
+        swept and rejected — close() never strands a waiter. Idempotent."""
         with self._lock:
             if self._closed and not self._worker.is_alive():
                 return
             self._closing = True
             self._cond.notify_all()
-        self._worker.join(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._closed:
+                    break       # the watchdog's shutdown sweep beat us
+                if self._failed or self._worker_gen != self._gen:
+                    # the current worker object is fenced (hard-fail, or a
+                    # restart aborted mid-backoff): it will never drain —
+                    # stop waiting and let the sweep below settle leftovers
+                    break
+                worker = self._worker
+            # lap-join, never an unbounded join: a worker wedged in a
+            # device call would otherwise block close(timeout=None)
+            # forever — the watchdog fences it at stall_timeout and sets
+            # _closed, which the lap re-check above observes
+            remaining = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            worker.join(0.2 if remaining is None else min(0.2, remaining))
+            with self._lock:
+                if not worker.is_alive() and worker is self._worker:
+                    break       # clean exit, no restart swapped it
+            if deadline is not None and time.monotonic() >= deadline:
+                break           # out of budget; sweep below
+        stranded: List[Ticket] = []
         with self._lock:
             self._closed = True
-            if self._worker.is_alive():
-                log.warning("pipeline worker did not stop within %ss",
-                            timeout)
+            wedged = self._worker.is_alive()
+            if wedged or self._outstanding > 0:
+                # the worker is stuck in a device call (or a restart was
+                # aborted mid-backoff with work still queued): fence it off
+                # and sweep — a fenced worker that later wakes sees a stale
+                # generation and exits without touching live state
+                self._gen += 1
+                stranded = self._collect_wedged_locked(include_queue=True)
+            self._cond.notify_all()
+        if stranded:
+            log.warning(
+                "pipeline close: worker %s; rejecting %d outstanding "
+                "ticket(s)", "did not stop within timeout" if wedged
+                else "already gone with work queued", len(stranded))
+            self._settle([(t, None, PipelineError(
+                "pipeline closed before this submission resolved"))
+                for t in stranded])
 
-    # -- runtime-tunable knobs (observe/autotune.py consumer) -----------------
+    # -- runtime-tunable knobs (observe/autotune.py + chaos consumers) --------
     @property
     def flush_ms(self) -> float:
         return self._flush_s * 1e3
@@ -346,6 +516,10 @@ class Pipeline:
     @property
     def max_bucket(self) -> int:
         return self._max_bucket
+
+    @property
+    def stall_timeout_s(self) -> float:
+        return self._watchdog.stall_timeout_s
 
     def set_flush_ms(self, flush_ms: float) -> None:
         """Retarget the microbatch coalesce deadline (applies to the next
@@ -365,30 +539,75 @@ class Pipeline:
         with self._lock:
             self._min_bucket = min_bucket
 
+    def set_stall_timeout_s(self, stall_timeout_s: float) -> None:
+        """Retarget the watchdog's stall budget (e.g. widen it before a
+        cold dispatch that will JIT-compile, shrink it in chaos drills)."""
+        if stall_timeout_s <= 0:
+            raise ValueError("stall_timeout_s must be > 0")
+        self._watchdog.stall_timeout_s = stall_timeout_s
+
     # -- introspection --------------------------------------------------------
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._failed:
+            return "failed"
+        if self._closed or self._closing:
+            return "closed"
+        if self._restarting:
+            return "restarting"
+        if self.breaker.state != "closed":
+            return "breaker-open"
+        return "ok"
+
     def stats(self) -> Dict:
         with self._lock:
             queue_depth = len(self._queue)
             outstanding = self._outstanding
+            pub = dict(self._pub)
+            state = self._state_locked()
+            restarts = self._restarts
+            submitted = self.submitted
+            admission_drops = self.admission_drops
+            shed_total = self.shed_total
+            shed_reasons = dict(self.shed_reasons)
+            unavailable = self.unavailable_total
         qw = self.metrics.histograms.get("pipeline_queue_wait_seconds")
+        flush_reasons = pub.get("flush_reasons") or dict(self.flush_reasons)
+        fill_rows = pub.get("fill_rows", 0)
+        bucket_rows = pub.get("bucket_rows", 0)
         return {
-            "submitted": self.submitted,
+            "state": state,
+            "submitted": submitted,
             "outstanding": outstanding,
             "queue_depth": queue_depth,
-            "staged_rows": self._staged_rows,
-            "inflight": len(self._inflight),
-            "admission_drops": self.admission_drops,
-            "dispatched_batches": self.dispatched_batches,
-            "completed_batches": self.completed_batches,
+            "staged_rows": pub.get("staged_rows", 0),
+            "inflight": pub.get("inflight", 0),
+            "admission_drops": admission_drops,
+            "dispatched_batches": pub.get("dispatched_batches",
+                                          self.dispatched_batches),
+            "completed_batches": pub.get("completed_batches",
+                                         self.completed_batches),
+            # monotone ints bumped mid-retry-loop: the attr is always
+            # current, the published snapshot only moves on batch
+            # boundaries — read the live value
             "dispatch_faults": self.dispatch_faults,
             "dispatch_errors": self.dispatch_errors,
-            "flush_reasons": dict(self.flush_reasons),
-            "fill_rows": self._fill_rows,
-            "bucket_rows": self._bucket_rows,
+            "flush_reasons": flush_reasons,
+            "fill_rows": fill_rows,
+            "bucket_rows": bucket_rows,
+            "shed_total": shed_total,
+            "shed_reasons": shed_reasons,
+            "unavailable_total": unavailable,
+            "restarts": restarts,
+            "max_restarts": self._max_restarts,
+            "stall_timeout_s": self._watchdog.stall_timeout_s,
+            "breaker": self.breaker.stats(),
             "flush_ms": self.flush_ms,
             "min_bucket": self._min_bucket,
-            "fill_ratio_avg": round(self._fill_rows
-                                    / max(1, self._bucket_rows), 4),
+            "fill_ratio_avg": round(fill_rows / max(1, bucket_rows), 4),
             "queue_wait_p50_ms": round(qw.quantile(0.5) * 1e3, 3)
             if qw else 0.0,
             "queue_wait_p99_ms": round(qw.quantile(0.99) * 1e3, 3)
@@ -396,45 +615,258 @@ class Pipeline:
             "closed": self._closed or self._closing,
         }
 
-    # -- worker side ----------------------------------------------------------
-    def _run(self) -> None:
-        try:
-            self._run_inner()
-        except BaseException:            # noqa: BLE001 — never strand tickets
-            log.exception("pipeline worker died; rejecting outstanding work")
-            exc = PipelineError("pipeline worker crashed")
-            with self._lock:
-                # flip closed under the lock FIRST so no producer can admit
-                # a ticket into the dead queue after we sweep it
-                self._closing = True
-                self._closed = True
-                pending = [s.ticket for s in self._queue]
-                self._queue.clear()
-            if self._current is not None:    # the sub that was mid-_ingest
-                pending.append(self._current.ticket)
-                self._current = None
-            pending.extend(sl.ticket for sl in self._staged_slices)
-            self._staged_slices = []
-            for inf in self._inflight:
-                pending.extend(sl.ticket for sl in inf.slices)
-            self._inflight.clear()
-            rejected = 0
-            for t in pending:
-                if not t.done():             # also dedups double-listed ones
-                    t._reject(exc)
-                    rejected += 1
-            with self._lock:
-                self._outstanding -= rejected
+    # -- guard plumbing -------------------------------------------------------
+    def _count_unavailable(self) -> None:
+        with self._lock:
+            self._count_unavailable_locked()
+
+    def _count_unavailable_locked(self) -> None:
+        self.unavailable_total += 1
+        self.metrics.inc_counter("pipeline_unavailable_total")
+
+    def _on_breaker_transition(self, _old: str, _new: str) -> None:
+        self._set_state_gauge()
+
+    def _set_state_gauge(self) -> None:
+        self.metrics.set_gauge("pipeline_state",
+                               PIPELINE_STATES.get(self.state(), -1))
+
+    def _hb_arm(self, label: str, gen: int, grace: int = 1) -> None:
+        # tuple assignment is atomic under the GIL; the watchdog reads it
+        self._hb = (time.monotonic(), label, gen, grace)
+
+    def _hb_clear(self, gen: int) -> None:
+        # gen-checked: a fenced-off worker waking from a stall must not
+        # clear the REPLACEMENT worker's armed heartbeat
+        hb = self._hb
+        if hb is not None and hb[2] == gen:
+            self._hb = None
+
+    def _stale(self, gen: int) -> bool:
+        return self._gen != gen
+
+    def _check_gen(self, gen: int) -> None:
+        """Raise the unwind signal when this worker has been superseded.
+        Called after every return from a blocking call — a fenced-off
+        worker must never touch live scheduler state again."""
+        if self._gen != gen:
+            raise _Superseded()
+
+    def _settle(self, outcomes) -> None:
+        """The single resolution path: ``outcomes`` is a sequence of
+        ``(ticket, out_or_None, exc_or_None)``. Settles each not-yet-done
+        ticket and adjusts ``_outstanding`` for exactly the tickets that
+        transitioned — under the lock, so a watchdog sweep racing a waking
+        worker can never double-resolve or double-count."""
+        with self._lock:
+            n = 0
+            for ticket, out, exc in outcomes:
+                if ticket.done():
+                    continue
+                if exc is not None:
+                    ticket._reject(exc)
+                else:
+                    ticket._resolve(out)
+                n += 1
+            self._outstanding -= n
+            # drain waiters only care about reaching zero; producers are
+            # woken by the queue pop — skip the per-batch thundering herd
+            if self._outstanding == 0 or self._closing:
                 self._cond.notify_all()
 
-    def _run_inner(self) -> None:
+    def _collect_wedged_locked(self, include_queue: bool) -> List[Ticket]:
+        """Lock held. Gather every ticket the (dead/wedged) worker owned —
+        mid-ingest sub, staged slices, a dispatch/finalize in progress, the
+        whole in-flight window, optionally the queue — and reset the
+        worker-owned state to a fresh staging ring."""
+        # read registries in DATA-FLOW order (current -> staged ->
+        # dispatching -> inflight -> finalizing): every worker hand-off
+        # adds to the destination before removing from the source, so a
+        # ticket mid-hand-off is seen in the source, the destination, or
+        # both — never in neither. (queue->_current happens under this
+        # lock, so reading the queue last is safe.)
+        wedged: List[Ticket] = []
+        if self._current is not None:
+            wedged.append(self._current.ticket)
+            self._current = None
+        wedged.extend(sl.ticket for sl in self._staged_slices)
+        wedged.extend(sl.ticket for sl in self._dispatching)
+        for inf in self._inflight:
+            wedged.extend(sl.ticket for sl in inf.slices)
+        if self._finalizing is not None:
+            wedged.extend(sl.ticket for sl in self._finalizing.slices)
+        if include_queue:
+            wedged.extend(s.ticket for s in self._queue)
+            self._queue.clear()
+            self.metrics.set_gauge("pipeline_queue_depth", 0)
+        # fresh staging ring: the old buffers may still be referenced by
+        # the fenced-off worker — never reuse them
+        self._buffers = [empty_batch(self._max_bucket)
+                         for _ in range(self._inflight_max + 1)]
+        self._free_bufs = list(range(len(self._buffers)))
+        self._stage_buf = None
+        self._staged_rows = 0
+        self._staged_slices = []
+        self._stage_now = None
+        self._dispatching = []
+        self._finalizing = None
+        self._inflight = deque()
+        self._hb = None
+        self._pub = {}
+        return wedged
+
+    def _restart_worker(self, gen: int, reason: str) -> None:
+        """The restart protocol (watchdog thread, or the dying worker
+        itself on a crash). Generation-fenced: a stale ``gen`` is a no-op,
+        so a watchdog firing while a crash restart is already underway
+        cannot double-restart."""
+        with self._lock:
+            if gen != self._gen or self._closed or self._failed:
+                return
+            if self._closing:
+                # shutdown is in flight: no replacement worker — fence the
+                # wedged one and sweep so close()/waiters unblock instead
+                # of waiting on a thread that will never return
+                self._gen += 1
+                stranded = self._collect_wedged_locked(include_queue=True)
+                self._closed = True
+                self._cond.notify_all()
+                shutdown_sweep = True
+            else:
+                shutdown_sweep = False
+                now = time.monotonic()
+                if self._restarts and \
+                        now - self._last_restart_mono > \
+                        RESTART_BUDGET_WINDOW_S:
+                    self._restarts = 0       # healthy interval: forgive
+                self._last_restart_mono = now
+                self._gen += 1
+                new_gen = self._gen
+                self._restarts += 1
+            if not shutdown_sweep:
+                restarts = self._restarts
+                self._restarting = True
+                wedged = self._collect_wedged_locked(
+                    include_queue=restarts > self._max_restarts)
+                hard_fail = restarts > self._max_restarts
+                if hard_fail:
+                    self._failed = True
+                self._cond.notify_all()
+        if shutdown_sweep:
+            log.warning("pipeline worker wedged during shutdown (%s); "
+                        "rejecting %d outstanding ticket(s)",
+                        reason, len(stranded))
+            self._settle([(t, None, PipelineError(
+                "pipeline closed before this submission resolved "
+                f"({reason})")) for t in stranded])
+            return
+        if hard_fail:
+            exc: PipelineError = PipelineUnavailable(
+                f"pipeline hard-failed after {restarts - 1} restarts "
+                f"({reason}); submission rejected")
+            self.metrics.inc_counter("pipeline_hard_failures_total")
+        else:
+            exc = PipelineError(
+                f"pipeline worker restarted ({reason}); in-flight window "
+                "rejected")
+        self.metrics.inc_counter("pipeline_restarts_total")
+        self._set_state_gauge()
+        self.tracer.event("pipeline.watchdog",
+                          action="hard-fail" if hard_fail else "restart",
+                          reason=reason, restarts=restarts,
+                          rejected=len(wedged))
+        log.warning("pipeline %s (restart %d/%d): %s; rejecting %d wedged "
+                    "ticket(s)",
+                    "HARD-FAILED" if hard_fail else "worker restarting",
+                    restarts, self._max_restarts, reason, len(wedged))
+        self._settle([(t, None, exc) for t in wedged])
+        if hard_fail:
+            with self._lock:
+                self._restarting = False
+                self._cond.notify_all()
+            self._set_state_gauge()
+            return
+        # capped exponential backoff between restarts: a persistently
+        # stalling backend gets breathing room instead of a restart storm
+        time.sleep(min(self._restart_backoff_s * (1 << (restarts - 1)),
+                       MAX_RESTART_BACKOFF_S))
+        with self._lock:
+            if self._closing or self._closed or self._gen != new_gen:
+                self._restarting = False
+                self._cond.notify_all()
+                return
+            self._worker = threading.Thread(
+                target=self._run, args=(new_gen,), daemon=True,
+                name=f"{self._name}-worker-g{new_gen}")
+            self._worker_gen = new_gen
+            self._cold_dispatch = True   # fresh gen: next dispatch is cold
+            self._worker.start()
+            self._restarting = False
+            self._cond.notify_all()
+        self._set_state_gauge()
+
+    def _on_worker_crash(self, gen: int) -> None:
+        """The dying worker's own exit path (crash, not stall)."""
+        with self._lock:
+            if gen != self._gen:
+                return               # a restart already superseded us
+            shutting_down = self._closing or self._closed
+        if shutting_down:
+            # no restart during shutdown: sweep and mark closed so close()
+            # and every waiter unblock
+            stranded: List[Ticket] = []
+            with self._lock:
+                self._gen += 1
+                stranded = self._collect_wedged_locked(include_queue=True)
+                self._closed = True
+                self._cond.notify_all()
+            self._settle([(t, None, PipelineError(
+                "pipeline worker crashed during shutdown"))
+                for t in stranded])
+            return
+        self._restart_worker(gen, "worker crashed")
+
+    def _shed(self, ticket: Ticket, reason: str) -> None:
+        """Deadline shed: the answer nobody is waiting for is not
+        computed. Counted per shed point in ``pipeline_shed_total``."""
+        with self._lock:
+            self.shed_total += 1
+            self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self.metrics.inc_counter(
+            f'pipeline_shed_total{{reason="{reason}"}}')
+        self.tracer.record(ticket.trace_id, "pipeline.shed",
+                           ticket.submitted_mono,
+                           time.monotonic() - ticket.submitted_mono,
+                           {"reason": reason})
+        self._settle([(ticket, None, PipelineDeadlineExceeded(
+            f"deadline exceeded before {reason} (seq={ticket.seq}, "
+            f"waited {(time.monotonic() - ticket.submitted_mono) * 1e3:.1f}"
+            "ms)"))])
+
+    # -- worker side ----------------------------------------------------------
+    def _run(self, gen: int) -> None:
+        try:
+            self._run_inner(gen)
+        except _Superseded:
+            return                       # fenced off; replacement owns state
+        except BaseException:            # noqa: BLE001 — never strand tickets
+            log.exception("pipeline worker (gen %d) died", gen)
+            self._on_worker_crash(gen)
+
+    def _run_inner(self, gen: int) -> None:
         while True:
             sub = None
             action = None
             with self._lock:
                 while True:
+                    if gen != self._gen or self._closed:
+                        return
                     if self._queue:
                         sub = self._queue.popleft()
+                        # hand-off under the lock: the sub must never be
+                        # in neither the queue nor _current when a
+                        # close/watchdog sweep runs
+                        self._current = sub
                         depth = len(self._queue)
                         self.metrics.set_gauge("pipeline_queue_depth", depth)
                         if depth >= self._queue_max - 1:
@@ -461,16 +893,19 @@ class Pipeline:
                                    - time.monotonic())
                     self._cond.wait(wait)
             if action == "ingest":
-                self._current = sub
-                self._ingest(sub)
+                self._ingest(sub, gen)     # _current was set at the pop
                 self._current = None
             elif action == "finalize":
-                self._finalize_oldest()
+                self._finalize_oldest(gen)
             else:
-                self._flush(action)
+                self._flush(action, gen)
 
-    def _ingest(self, sub: _Sub) -> None:
+    def _ingest(self, sub: _Sub, gen: int) -> None:
         t = sub.ticket
+        if t.deadline_mono is not None \
+                and time.monotonic() > t.deadline_mono:
+            self._shed(t, "ingest")
+            return
         m = t.n_valid
         if m == 0:
             # nothing to classify: resolve without a device round trip
@@ -479,21 +914,23 @@ class Pipeline:
                 wait)
             self.tracer.record(t.trace_id, "pipeline.admission",
                                t.submitted_mono, wait)
-            t._resolve(_zero_out(t.n_rows))
-            self._resolved(1)
+            self._settle([(t, _zero_out(t.n_rows), None)])
             return
         rows = t.n_rows
         if (self._staged_rows == 0
                 and self._min_bucket <= rows <= self._max_bucket
                 and rows & (rows - 1) == 0):
-            # already bucket-shaped: zero-copy direct dispatch
+            # already bucket-shaped: zero-copy direct dispatch (_current
+            # stays set across the hand-off into _dispatching — a ticket
+            # is always visible in at least one sweep registry)
             self._dispatch(sub.batch, sub.now,
-                           [_Slice(t, None, 0)], rows, m, "direct", None)
+                           [_Slice(t, None, 0)], rows, m, "direct", None,
+                           gen)
             return
         if self._staged_rows + m > self._max_bucket:
-            self._flush("full")
+            self._flush("full", gen)
         if self._stage_buf is None:
-            self._stage_buf = self._acquire_buffer()
+            self._stage_buf = self._acquire_buffer(gen)
             # the deadline is anchored to the oldest rider's SUBMIT time so
             # backlogged submissions flush immediately instead of waiting
             # another full window
@@ -509,31 +946,68 @@ class Pipeline:
             self._stage_now = sub.now
         self._staged_slices.append(_Slice(t, valid_idx, pos))
         self._staged_rows += m
+        self._publish(gen)
         if self._staged_rows >= self._max_bucket:
-            self._flush("full")
+            self._flush("full", gen)
 
-    def _flush(self, reason: str) -> None:
+    def _flush(self, reason: str, gen: int) -> None:
         if not self._staged_slices:
             return
         buf_idx = self._stage_buf
         buf = self._buffers[buf_idx]
         rows = self._staged_rows
-        bucket = max(self._min_bucket, _next_pow2(rows))
-        buf["valid"][rows:bucket] = False    # reused buffer: mask stale rows
-        view = {k: col[:bucket] for k, col in buf.items()}
         slices = self._staged_slices
         now = self._stage_now
+        # hand-off ordering: into _dispatching BEFORE leaving the staged
+        # registry, so a concurrent sweep always sees every ticket
+        self._dispatching = slices
         self._stage_buf = None
         self._staged_rows = 0
         self._staged_slices = []
         self._stage_now = None
-        self._dispatch(view, now, slices, bucket, rows, reason, buf_idx)
+        # deadline shed at flush time: riders whose deadline passed while
+        # coalescing are masked out of the bucket and rejected — the
+        # device never spends a cycle on them
+        now_mono = time.monotonic()
+        live: List[_Slice] = []
+        for sl in slices:
+            dl = sl.ticket.deadline_mono
+            if dl is not None and now_mono > dl:
+                n = len(sl.valid_idx)
+                buf["valid"][sl.dst_start:sl.dst_start + n] = False
+                self._shed(sl.ticket, "flush")
+            else:
+                live.append(sl)
+        if not live:
+            self._dispatching = []       # every slice settled by _shed
+            self._recycle(buf_idx)
+            self._publish(gen)
+            return
+        n_valid = sum(len(sl.valid_idx) for sl in live)
+        bucket = max(self._min_bucket, _next_pow2(rows))
+        buf["valid"][rows:bucket] = False    # reused buffer: mask stale rows
+        view = {k: col[:bucket] for k, col in buf.items()}
+        self._dispatch(view, now, live, bucket, n_valid, reason, buf_idx,
+                       gen)
 
     def _dispatch(self, batch: Dict[str, np.ndarray], now: Optional[int],
                   slices: List[_Slice], bucket_rows: int, n_valid: int,
-                  reason: str, buf_idx: Optional[int]) -> None:
+                  reason: str, buf_idx: Optional[int], gen: int) -> None:
+        # hand-off ordering invariant: these slices are in _dispatching
+        # from before they leave any upstream registry until after they
+        # are settled or appended to _inflight — a concurrent sweep can
+        # never catch a ticket in no registry at all
+        self._dispatching = slices
         if now is None:
             now = int(time.time())
+        if self.breaker.state == "open":
+            # opened while this batch staged/queued: reject fast rather
+            # than hammering the sick backend with its rows
+            self._count_unavailable()
+            self._reject_slices(slices, PipelineUnavailable(
+                "circuit breaker open; dispatch suppressed"), buf_idx)
+            self._dispatching = []
+            return
         self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
         self.metrics.inc_counter(f"pipeline_flush_{reason}_total")
         self._fill_rows += n_valid
@@ -556,61 +1030,109 @@ class Pipeline:
         attempts = 0
         while True:
             try:
+                self._hb_arm("dispatch", gen,
+                             grace=COLD_DISPATCH_GRACE
+                             if self._cold_dispatch else 1)
                 FAULTS.fire("pipeline.dispatch")
+                # a fenced-off worker released from a hang-mode stall must
+                # not dispatch: its window was already rejected — reaching
+                # the datapath now would mutate CT for nobody
+                self._check_gen(gen)
                 with self.tracer.context(tid), \
                         self.tracer.span(tid, "pipeline.dispatch",
                                          bucket=bucket_rows,
                                          n_valid=n_valid, reason=reason):
                     finalize = self._dispatch_fn(batch, now)
+                self._hb_clear(gen)
+                self._check_gen(gen)
                 break
             except FaultInjected as e:
+                self._hb_clear(gen)
+                self._check_gen(gen)
                 self.dispatch_faults += 1
                 self.metrics.inc_counter("pipeline_dispatch_faults_total")
                 attempts += 1
+                if self.breaker.record_failure():
+                    # the breaker opened: stop burning the retry budget
+                    # against a backend that is failing every attempt
+                    self._count_unavailable()
+                    self._reject_slices(slices, PipelineUnavailable(
+                        f"circuit breaker opened after {attempts} dispatch "
+                        f"attempts: {e}"), buf_idx)
+                    self._dispatching = []
+                    return
                 cap = (MAX_DISPATCH_RETRIES_CLOSING if self._closing
                        else MAX_DISPATCH_RETRIES)
                 if attempts >= cap:
                     self._reject_slices(slices, e, buf_idx)
+                    self._dispatching = []
                     return
                 time.sleep(min(0.05, 0.0005 * (1 << min(attempts, 7))))
             except Exception as e:   # noqa: BLE001 — supervised degradation
+                self._hb_clear(gen)
+                self._check_gen(gen)
                 self.dispatch_errors += 1
                 self.metrics.inc_counter("pipeline_dispatch_errors_total")
+                self.breaker.record_failure()
                 log.warning("pipeline dispatch failed, rejecting %d "
                             "submission(s): %s", len(slices), e)
                 self._reject_slices(slices, e, buf_idx)
+                self._dispatching = []
                 return
+        # a successful dispatch is only an *enqueue* — the failure streak
+        # resets on finalize (the device actually answering). The
+        # exception is the half-open probe: its dispatch succeeding is the
+        # close signal (the issue's "half-open probe dispatches close it")
+        if self.breaker.state != "closed":
+            self.breaker.record_success()
+        self._cold_dispatch = False      # this generation is warm now
         self.dispatched_batches += 1
         self._inflight.append(_Inflight(finalize, slices, t0, buf_idx))
+        self._dispatching = []           # now visible in _inflight
         self.metrics.set_gauge("pipeline_inflight", len(self._inflight))
+        self._publish(gen)
         # keep at most ``inflight`` batches genuinely in flight; the ring
         # has inflight+1 staging buffers so the next microbatch can stage
         # while the window is full
         while len(self._inflight) > self._inflight_max:
-            self._finalize_oldest()
+            self._finalize_oldest(gen)
 
-    def _finalize_oldest(self) -> None:
+    def _finalize_oldest(self, gen: int) -> None:
         if not self._inflight:
             return
-        inf: _Inflight = self._inflight.popleft()
+        # hand-off ordering: into _finalizing BEFORE leaving _inflight
+        inf: _Inflight = self._inflight[0]
+        self._finalizing = inf
+        self._inflight.popleft()
         tid = next((sl.ticket.trace_id for sl in inf.slices
                     if sl.ticket.trace_id is not None), None)
         try:
+            self._hb_arm("finalize", gen)
+            FAULTS.fire("pipeline.finalize")
+            self._check_gen(gen)     # hang-released fence: do not finalize
             with self.tracer.context(tid), \
                     self.tracer.span(tid, "pipeline.finalize"):
                 out = inf.finalize()
-        except Exception as e:   # noqa: BLE001
+            self._hb_clear(gen)
+        except Exception as e:   # noqa: BLE001 — incl. injected trips
+            self._hb_clear(gen)
+            self._check_gen(gen)
             self.dispatch_errors += 1
             self.metrics.inc_counter("pipeline_dispatch_errors_total")
+            self.breaker.record_failure()
             log.warning("pipeline finalize failed, rejecting %d "
                         "submission(s): %s", len(inf.slices), e)
             self._reject_slices(inf.slices, e, inf.buf_idx)
+            self._finalizing = None      # settled above
             return
+        self._check_gen(gen)
+        self.breaker.record_success()
         self.metrics.histogram("pipeline_batch_latency_seconds").observe(
             time.monotonic() - inf.t_dispatch)
+        outcomes = []
         for sl in inf.slices:
             if sl.valid_idx is None:        # direct: geometry already matches
-                sl.ticket._resolve(out)
+                outcomes.append((sl.ticket, out, None))
                 continue
             n = len(sl.valid_idx)
             tout = _zero_out(sl.ticket.n_rows)
@@ -619,16 +1141,36 @@ class Pipeline:
                     tout[k] = np.zeros((sl.ticket.n_rows,) + arr.shape[1:],
                                        dtype=arr.dtype)
                 tout[k][sl.valid_idx] = arr[sl.dst_start:sl.dst_start + n]
-            sl.ticket._resolve(tout)
+            outcomes.append((sl.ticket, tout, None))
         self.completed_batches += 1
         self._recycle(inf.buf_idx)
         self.metrics.set_gauge("pipeline_inflight", len(self._inflight))
-        self._resolved(len(inf.slices))
+        self._publish(gen)
+        self._settle(outcomes)
+        self._finalizing = None          # settled above
 
     # -- small helpers ---------------------------------------------------------
-    def _acquire_buffer(self) -> int:
+    def _publish(self, gen: int) -> None:
+        """Worker-side: publish a consistent snapshot of the worker-owned
+        stats under the lock (what ``stats()`` reads instead of racing the
+        worker's in-flux fields)."""
+        snapshot = {
+            "staged_rows": self._staged_rows,
+            "flush_reasons": dict(self.flush_reasons),
+            "fill_rows": self._fill_rows,
+            "bucket_rows": self._bucket_rows,
+            "inflight": len(self._inflight),
+            "dispatched_batches": self.dispatched_batches,
+            "completed_batches": self.completed_batches,
+        }
+        with self._lock:
+            if gen == self._gen:         # a fenced worker must not publish
+                self._pub = snapshot
+
+    def _acquire_buffer(self, gen: int) -> int:
         while not self._free_bufs:
-            self._finalize_oldest()
+            self._check_gen(gen)
+            self._finalize_oldest(gen)
         return self._free_bufs.pop()
 
     def _recycle(self, buf_idx: Optional[int]) -> None:
@@ -640,15 +1182,5 @@ class Pipeline:
         wrapped = exc if isinstance(exc, PipelineError) else \
             PipelineError(f"dispatch failed: {type(exc).__name__}: {exc}")
         wrapped.__cause__ = exc
-        for sl in slices:
-            sl.ticket._reject(wrapped)
         self._recycle(buf_idx)
-        self._resolved(len(slices))
-
-    def _resolved(self, n: int) -> None:
-        with self._lock:
-            self._outstanding -= n
-            # drain waiters only care about reaching zero; producers are
-            # woken by the queue pop — skip the per-batch thundering herd
-            if self._outstanding == 0 or self._closing:
-                self._cond.notify_all()
+        self._settle([(sl.ticket, None, wrapped) for sl in slices])

@@ -31,6 +31,13 @@ Routes (all under /v1):
   PATCH /v1/config            {"enforcement_mode": ...} (upstream: `cilium
                               config PolicyEnforcement=...`)
   GET  /v1/health             datapath health probe through real classify
+  POST /v1/classify           serve a batch of flows through the ingestion
+                              pipeline ({"records": [{src,dst,sport,dport,
+                              proto,ep,direction},...]}); Ticket.result()
+                              is bounded by config.pipeline_request_timeout_s
+                              — overload shed (queue full / deadline) maps
+                              to 429, breaker-open / hard-failed / timeout
+                              to 503, always with a JSON error body
   POST /v1/regenerate         force a recompile
   GET  /v1/faults             fault-injection point list + fire/trip stats
   POST /v1/faults             arm ({"spec": "point=mode:..."}) or disarm
@@ -237,6 +244,89 @@ def ct_doc(engine: "Engine", limit: int, now: Optional[int]):
     return out
 
 
+def serving_error(exc: BaseException) -> Optional[Tuple[int, Dict]]:
+    """Map a pipeline serving failure to (http_status, json_body), or None
+    for errors that are not part of the overload/degradation taxonomy
+    (those stay 500s). Overload shed → 429 (retryable: the pipeline is
+    healthy but this submission lost the overload race); unavailability →
+    503 (the backend is sick/restarting — back off)."""
+    from cilium_tpu.pipeline.guard import (PipelineDeadlineExceeded,
+                                           PipelineDrop, PipelineError)
+    doc = {"error": str(exc), "kind": type(exc).__name__}
+    if isinstance(exc, (PipelineDrop, PipelineDeadlineExceeded)):
+        return 429, doc
+    # every other PipelineError (PipelineUnavailable, PipelineClosed,
+    # restart rejections) and a bounded-wait timeout → 503
+    if isinstance(exc, (PipelineError, TimeoutError)):
+        return 503, doc
+    return None
+
+
+def classify_doc(engine: "Engine", body: Dict) -> Tuple[int, Dict]:
+    """The REST serving path: build a batch from JSON flow records, submit
+    it through the ingestion pipeline, wait bounded, return verdicts."""
+    from oracle import PacketRecord
+    from cilium_tpu.kernels.records import batch_from_records
+    from cilium_tpu.utils.ip import parse_addr
+
+    records = body.get("records")
+    if not records or not isinstance(records, list):
+        return 400, {"error": "classify requires a non-empty 'records' list"}
+    names = {v.upper(): k for k, v in C.PROTO_NAMES.items()}
+    recs = []
+    for i, r in enumerate(records):
+        missing = [k for k in ("src", "dst", "dport", "ep") if k not in r]
+        if missing:
+            return 400, {"error": f"record {i} missing {missing}"}
+        proto = r.get("proto", "TCP")
+        if isinstance(proto, str):
+            proto = int(proto) if proto.isdigit() \
+                else names.get(proto.upper())
+            if proto is None:
+                return 400, {"error": f"record {i}: unknown protocol"}
+        try:
+            s16, s6 = parse_addr(r["src"])
+            d16, d6 = parse_addr(r["dst"])
+        except Exception as e:   # noqa: BLE001 — caller-supplied addresses
+            return 400, {"error": f"record {i}: bad address ({e})"}
+        direction = C.DIR_INGRESS if r.get("direction") == "ingress" \
+            else C.DIR_EGRESS
+        try:
+            recs.append(PacketRecord(
+                s16, d16, int(r.get("sport", 0)), int(r["dport"]), proto,
+                int(r.get("flags", C.TCP_SYN)), s6 or d6, int(r["ep"]),
+                direction))
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"record {i}: bad numeric field ({e})"}
+    try:
+        now = int(body["now"]) if "now" in body else None
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+    except (TypeError, ValueError) as e:
+        return 400, {"error": f"bad now/deadline_ms ({e})"}
+    snapshot = engine.active.snapshot
+    batch = batch_from_records(recs, snapshot.ep_slot_of)
+    try:
+        ticket = engine.submit(batch, now=now, deadline_ms=deadline_ms)
+        out = ticket.result(
+            timeout=engine.config.pipeline_request_timeout_s)
+    except Exception as exc:   # noqa: BLE001 — taxonomy-mapped below
+        mapped = serving_error(exc)
+        if mapped is None:
+            raise
+        return mapped
+    verdicts = []
+    for i in range(len(recs)):
+        verdicts.append({
+            "allow": bool(out["allow"][i]),
+            "reason": C.DropReason(int(out["reason"][i])).name,
+            "ct_state": C.CTStatus(int(out["status"][i])).name,
+            "remote_identity": int(out["remote_identity"][i]),
+        })
+    return 200, {"count": len(verdicts), "verdicts": verdicts}
+
+
 def trace_doc(engine: "Engine", body: Dict) -> Tuple[int, Dict]:
     from cilium_tpu.model.ipcache import lpm_lookup
     missing = [k for k in ("ep", "remote", "dport") if k not in body]
@@ -422,6 +512,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, {"revision": rev})
             if path == "/v1/policy/trace":
                 code, doc = trace_doc(eng, self._body())
+                return self._send_json(code, doc)
+            if path == "/v1/classify":
+                code, doc = classify_doc(eng, self._body())
                 return self._send_json(code, doc)
             if path == "/v1/regenerate":
                 compiled = eng.regenerate(force=True)

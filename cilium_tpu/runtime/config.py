@@ -49,6 +49,17 @@ class DaemonConfig:
     pipeline_flush_ms: float = 2.0      # microbatch coalesce deadline
     pipeline_min_bucket: int = 256      # smallest dispatch shape (pow2)
     pipeline_inflight: int = 2          # overlapped batches in flight
+    # --- pipeline guard (pipeline/guard.py): overload + self-healing ---
+    pipeline_deadline_ms: float = 0.0   # per-submission deadline (0 = none)
+    pipeline_request_timeout_s: float = 10.0  # REST/CLI Ticket.result bound
+    pipeline_breaker_threshold: int = 20  # consecutive failures → open
+    pipeline_breaker_cooldown_s: float = 5.0  # open → half-open probe delay
+    # heartbeat age → watchdog restart; a generation's FIRST dispatch gets
+    # 4x this budget (COLD_DISPATCH_GRACE) so a cold-shape XLA compile can
+    # never look like a device stall and restart-loop a healthy daemon
+    pipeline_stall_timeout_s: float = 30.0
+    pipeline_max_restarts: int = 3      # restart budget, then hard-failed
+    pipeline_restart_backoff_s: float = 0.2  # base (capped exponential)
     # --- api ---
     api_socket: str = ""           # unix-socket REST path ("" = disabled)
     # --- multi-host sync (clustermesh analog; runtime/clustermesh.py) ---
@@ -92,6 +103,20 @@ class DaemonConfig:
         if self.pipeline_inflight < 1 or self.pipeline_queue_batches < 1:
             raise ValueError(
                 "pipeline_inflight and pipeline_queue_batches must be >= 1")
+        if self.pipeline_deadline_ms < 0:
+            raise ValueError("pipeline_deadline_ms must be >= 0 (0 = none)")
+        if self.pipeline_request_timeout_s <= 0:
+            raise ValueError("pipeline_request_timeout_s must be > 0")
+        if self.pipeline_breaker_threshold < 1:
+            raise ValueError("pipeline_breaker_threshold must be >= 1")
+        if self.pipeline_breaker_cooldown_s <= 0 \
+                or self.pipeline_stall_timeout_s <= 0:
+            raise ValueError("pipeline_breaker_cooldown_s and "
+                             "pipeline_stall_timeout_s must be > 0")
+        if self.pipeline_max_restarts < 0 \
+                or self.pipeline_restart_backoff_s <= 0:
+            raise ValueError("pipeline_max_restarts must be >= 0 and "
+                             "pipeline_restart_backoff_s > 0")
         if not 0.0 <= self.trace_sample_rate <= 1.0:
             raise ValueError("trace_sample_rate must be in [0, 1]")
         if self.trace_capacity < 1:

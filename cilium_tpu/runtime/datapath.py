@@ -33,6 +33,7 @@ from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
 from cilium_tpu.compile.snapshot import PolicySnapshot
 from cilium_tpu.observe.trace import active as active_trace
 from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
 
 OutArrays = Dict[str, np.ndarray]
@@ -297,6 +298,9 @@ class JITDatapath(DatapathBackend):
                 wire = pack_batch(b)
         with tracer.span(trace_id, "datapath.transfer",
                          bytes=int(wire.nbytes)):
+            # chaos point: a wedged/failed host→device link (hang mode is
+            # what the pipeline watchdog drill stalls on)
+            FAULTS.fire("datapath.transfer")
             if path_dict is not None:
                 dev_batch = (jnp.asarray(wire), jnp.asarray(path_dict))
             else:
@@ -326,6 +330,7 @@ class JITDatapath(DatapathBackend):
             steered, scatter, _per = steer_batch(
                 batch, self.n_flow_shards, lb=lb, round_to_pow2=True)
         with tracer.span(trace_id, "datapath.transfer"):
+            FAULTS.fire("datapath.transfer")
             with self._ct_lock:
                 out, new_ct, counters = self._classify(
                     placed, self._ct, steered, jnp.uint32(now),

@@ -401,15 +401,28 @@ class Engine:
                     admission=cfg.pipeline_admission,
                     block_timeout_s=cfg.pipeline_block_timeout_s,
                     flush_ms=cfg.pipeline_flush_ms,
-                    inflight=cfg.pipeline_inflight)
+                    inflight=cfg.pipeline_inflight,
+                    deadline_ms=cfg.pipeline_deadline_ms,
+                    breaker_threshold=cfg.pipeline_breaker_threshold,
+                    breaker_cooldown_s=cfg.pipeline_breaker_cooldown_s,
+                    stall_timeout_s=cfg.pipeline_stall_timeout_s,
+                    max_restarts=cfg.pipeline_max_restarts,
+                    restart_backoff_s=cfg.pipeline_restart_backoff_s)
             return self._pipeline
 
     def submit(self, batch: Dict[str, np.ndarray],
-               now: Optional[int] = None):
+               now: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
         """Admit one batch into the ingestion pipeline; returns a Ticket
         whose ``result()`` is bit-identical to what :meth:`classify` would
-        return for the same batch in the same order."""
-        return self.start_pipeline().submit(batch, now=now)
+        return for the same batch in the same order. ``deadline_ms``
+        bounds staleness (default ``config.pipeline_deadline_ms``); a
+        submission the worker cannot serve in time is shed with
+        ``PipelineDeadlineExceeded``. Raises ``PipelineUnavailable`` while
+        the dispatch circuit breaker is open or after the pipeline
+        hard-failed (watchdog restart budget exhausted)."""
+        return self.start_pipeline().submit(batch, now=now,
+                                            deadline_ms=deadline_ms)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until every pipeline submission so far has resolved."""
@@ -517,11 +530,18 @@ class Engine:
         States:
           OK        — the active snapshot is the current compiled state
           DEGRADED  — regeneration is failing; serving the last-good
-                      snapshot, which is still semantically current
+                      snapshot, which is still semantically current — OR
+                      the serving pipeline is degraded (breaker open,
+                      watchdog restart in progress, or hard-failed) while
+                      the synchronous classify path still answers
           STALE     — regeneration is failing AND committed policy changes
                       (repo revision > active revision) cannot be compiled:
                       verdicts are correct for an older policy world
-        """
+
+        When the ingestion pipeline exists its guard state
+        (ok/breaker-open/restarting/failed — pipeline/guard.py) is folded
+        in under the ``pipeline`` key and into the overall ``state``, plus
+        the ``pipeline_state`` gauge."""
         with self._lock:
             active = self._active
             state = C.HEALTH_OK
@@ -529,13 +549,32 @@ class Engine:
                 state = C.HEALTH_DEGRADED
                 if active is not None and self.repo.revision > active.revision:
                     state = C.HEALTH_STALE
-            return {
+            doc = {
                 "state": state,
                 "consecutive_regen_failures": self._regen_failures,
                 "last_regen_error": self._last_regen_error,
                 "active_revision": active.revision if active else None,
                 "repo_revision": self.repo.revision,
             }
+            pl = self._pipeline
+        if pl is not None:
+            # outside the engine lock: pipeline stats take the pipeline
+            # lock and must stay a leaf in the lock order; one snapshot
+            # carries state, restarts and breaker together
+            ps = pl.stats()
+            pstate = ps["state"]
+            doc["pipeline"] = {
+                "state": pstate,
+                "restarts": ps["restarts"],
+                "breaker": ps["breaker"],
+            }
+            from cilium_tpu.pipeline.guard import PIPELINE_STATES
+            self.metrics.set_gauge("pipeline_state",
+                                   PIPELINE_STATES.get(pstate, -1))
+            if pstate in ("breaker-open", "restarting", "failed") \
+                    and doc["state"] == C.HEALTH_OK:
+                doc["state"] = C.HEALTH_DEGRADED
+        return doc
 
     def health_probe(self, now: Optional[int] = None) -> Dict:
         """Datapath health check (cilium-health analog): classify one ICMP

@@ -13,6 +13,10 @@ point                   fires in
 ``api.handler``         REST dispatch (every method) in ``api._Handler``
 ``pipeline.dispatch``   per-microbatch dispatch in the ingestion pipeline
                         worker (``pipeline/scheduler.py``)
+``pipeline.finalize``   per-batch finalize in the pipeline worker (trips
+                        reject the batch; ``hang`` stalls for the watchdog)
+``datapath.transfer``   host→device transfer enqueue inside
+                        ``JITDatapath.classify_async``
 ======================  =====================================================
 
 Each point can be **armed** with one spec:
@@ -22,6 +26,12 @@ Each point can be **armed** with one spec:
 * ``prob`` (``prob=P, seed=S``): raise with probability P from a private
   seeded ``random.Random`` — fully deterministic, no wall clock.
 * ``delay`` (``delay_s=T``): inject latency (sleep) instead of failing.
+* ``hang`` (``delay_s=T, times=N``): a **cooperative stall** — the firing
+  thread blocks inside the point (simulating a wedged device call) until
+  the point is disarmed or the cap T (hard-clamped to
+  :data:`HANG_HARD_CAP_S`) elapses, then returns normally. This is what
+  drives the pipeline watchdog's stall detection without ever being able
+  to deadlock a test.
 
 Activation is either programmatic (the :meth:`FaultInjector.inject` context
 manager, used by tests) or via the environment::
@@ -60,8 +70,20 @@ POINTS: Dict[str, str] = {
     "api.handler": "REST request dispatch in the unix-socket API server",
     "pipeline.dispatch": "per-microbatch dispatch in the ingestion "
                          "pipeline worker (trips are retried — batches "
-                         "delay, never drop)",
+                         "delay, never drop — until the circuit breaker "
+                         "opens; hang mode stalls for the watchdog)",
+    "pipeline.finalize": "per-batch finalize in the ingestion pipeline "
+                         "worker (trips reject the batch's tickets and "
+                         "feed the circuit breaker; hang mode stalls for "
+                         "the watchdog)",
+    "datapath.transfer": "host→device transfer enqueue in "
+                         "JITDatapath.classify_async (serial classify and "
+                         "the pipeline both route through it)",
 }
+
+#: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a
+#: hung thread is always released — tests and chaos drills cannot deadlock
+HANG_HARD_CAP_S = 30.0
 
 
 class FaultInjected(RuntimeError):
@@ -85,7 +107,7 @@ class FaultSpec:
     message: str = ""
 
     def __post_init__(self):
-        if self.mode not in ("fail", "prob", "delay"):
+        if self.mode not in ("fail", "prob", "delay", "hang"):
             raise ValueError(f"bad fault mode {self.mode!r}")
         if self.mode == "prob" and not (0.0 <= self.prob <= 1.0):
             raise ValueError(f"bad fault probability {self.prob!r}")
@@ -163,7 +185,7 @@ class FaultInjector:
                     kw["times"] = a
                 elif mode == "prob":
                     kw["prob"] = a
-                elif mode == "delay":
+                elif mode in ("delay", "hang"):
                     kw["delay_s"] = a
             if "times" in kw:
                 kw["times"] = int(kw["times"])
@@ -188,9 +210,11 @@ class FaultInjector:
 
     # -- firing ------------------------------------------------------------
     def fire(self, point: str) -> None:
-        """Call at an injection site. Raises FaultInjected / sleeps when the
-        point is armed and the spec trips; otherwise a cheap no-op."""
+        """Call at an injection site. Raises FaultInjected / sleeps /
+        stalls when the point is armed and the spec trips; otherwise a
+        cheap no-op."""
         delay = None
+        hang_cap = None
         with self._lock:
             self._fired[point] = self._fired.get(point, 0) + 1
             armed = self._armed.get(point)
@@ -198,7 +222,7 @@ class FaultInjector:
                 return
             armed.fires += 1
             spec = armed.spec
-            if spec.mode == "fail":
+            if spec.mode in ("fail", "delay", "hang"):
                 if spec.times is not None and armed.trips >= spec.times:
                     return
             elif spec.mode == "prob":
@@ -207,12 +231,31 @@ class FaultInjector:
             armed.trips += 1
             if spec.mode == "delay":
                 delay = spec.delay_s
+            elif spec.mode == "hang":
+                hang_cap = min(spec.delay_s or HANG_HARD_CAP_S,
+                               HANG_HARD_CAP_S)
         if delay is not None:
             time.sleep(delay)
+            return
+        if hang_cap is not None:
+            self._hang(point, hang_cap)
             return
         raise FaultInjected(
             f"injected fault at {point}"
             + (f": {spec.message}" if spec.message else ""))
+
+    def _hang(self, point: str, cap_s: float) -> None:
+        """The cooperative stall: block in small increments until the
+        point is disarmed (a chaos driver releasing its victims) or the
+        hard cap elapses, then return normally — the caller proceeds as if
+        the device finally answered."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < cap_s:
+            time.sleep(min(0.02, cap_s))
+            with self._lock:
+                armed = self._armed.get(point)
+                if armed is None or armed.spec.mode != "hang":
+                    return
 
     # -- introspection -----------------------------------------------------
     def armed(self) -> Dict[str, FaultSpec]:

@@ -190,8 +190,15 @@ class Metrics:
             lines.append(f"ciliumtpu_packets_total {self.packets_total}")
             lines.append("# TYPE ciliumtpu_batches_total counter")
             lines.append(f"ciliumtpu_batches_total {self.batches_total}")
+            # counters may carry a label set in the name (e.g.
+            # ``pipeline_shed_total{reason="flush"}``); the TYPE line is
+            # emitted once per base metric, not per label combination
+            typed = set()
             for name, v in sorted(self.counters.items()):
-                lines.append(f"# TYPE ciliumtpu_{name} counter")
+                base = name.split("{", 1)[0]
+                if base not in typed:
+                    lines.append(f"# TYPE ciliumtpu_{base} counter")
+                    typed.add(base)
                 lines.append(f"ciliumtpu_{name} {v}")
             for name, g in sorted(self.gauges.items()):
                 lines.append(f"# TYPE ciliumtpu_{name} gauge")

@@ -656,11 +656,18 @@ class TestChaosCLI:
         assert doc["ok"] is True
         phases = {p["phase"]: p for p in doc["phases"]}
         assert set(phases) == {"regen-storm", "regen-recovery", "peer-flap",
-                               "pipeline-storm", "checkpoint-corruption"}
+                               "pipeline-storm", "stall-storm", "breaker",
+                               "checkpoint-corruption"}
         assert all(p["ok"] for p in doc["phases"])
         assert "0 classify errors" in phases["regen-storm"]["detail"]
         assert "0 errors, 0 verdict divergences" in \
             phases["pipeline-storm"]["detail"]
+        # the guard phases: a watchdog restart actually happened and the
+        # breaker opened within its threshold budget
+        assert "watchdog restart" in phases["stall-storm"]["detail"]
+        assert "3/3 post-restart submissions matched baseline" in \
+            phases["stall-storm"]["detail"]
+        assert "probe closed breaker" in phases["breaker"]["detail"]
 
     @pytest.mark.slow
     def test_chaos_scenario_jit_datapath(self, capsys):

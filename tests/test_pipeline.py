@@ -252,21 +252,29 @@ class TestPipelineUnit:
             pl.submit(sub_batch(4, start=0))
         pl.close(timeout=1)                 # idempotent
 
-    def test_worker_crash_rejects_current_submission(self):
+    def test_worker_crash_restarts_supervised(self):
         """A submission that crashes the worker mid-staging (malformed
         batch: missing columns) must come back rejected — not strand its
-        ticket forever — and the dead pipeline refuses new work."""
+        ticket forever — and the watchdog-supervised restart keeps the
+        pipeline serving (guard layer: crash → bounded restart, not a
+        permanently dead pipeline)."""
         d = EchoDispatch()
-        pl = Pipeline(d, min_bucket=4, max_bucket=16, flush_ms=1000.0)
+        pl = Pipeline(d, min_bucket=4, max_bucket=16, flush_ms=1000.0,
+                      restart_backoff_s=0.01)
         bad = {"valid": np.ones(3, bool),
                "sport": np.arange(3, dtype=np.int32)}   # not a full batch
         t = pl.submit(bad)
         with pytest.raises(PipelineError):
             t.result(timeout=5)
         assert pl.drain(timeout=5)          # outstanding went back to zero
+        # supervised restart: a fresh worker picks up where the dead one
+        # wedged — new submissions still serve
+        ok = pl.submit(sub_batch(4, start=0))
+        assert ok.result(timeout=5)["allow"].all()
+        assert pl.stats()["restarts"] == 1
+        pl.close(timeout=5)
         with pytest.raises(PipelineClosed):
             pl.submit(sub_batch(4, start=0))
-        pl.close(timeout=5)
 
     def test_stats_shape(self):
         d = EchoDispatch()

@@ -1,0 +1,728 @@
+"""Pipeline guard tests (pipeline/guard.py + the scheduler surgery):
+overload protection and self-healing for the serving pipeline.
+
+Unit tests drive a raw Pipeline against the recording EchoDispatch:
+per-submission deadline shed at ingest and at flush (counted per reason in
+``pipeline_shed_total{reason}``), circuit-breaker open → fail-fast →
+half-open probe → close (traced + counted, no per-submission 1000-retry
+burn), watchdog-supervised restart on a hang-mode stall and on worker
+crash, hard-fail past the restart budget, the close(timeout) sweep, and
+the drain-vs-close / blocked-submit-vs-close races.
+
+Integration tests go through Engine on FakeDatapath and pin the acceptance
+contracts: a ``hang``-forced watchdog restart mid-stream leaves no ticket
+blocked forever and post-restart verdicts bit-identical to the serial
+``classify`` path; breaker state folds into ``Engine.health()`` /
+``healthz`` / Prometheus; the REST serving route maps shed → 429 and
+unavailable/timeout → 503. The ``slow``-marked soak (`make chaos` tail)
+pushes 10k submissions through three forced watchdog restarts and asserts
+nothing resolved is lost, reordered, or double-dispatched.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.kernels.records import batch_from_records, empty_batch
+from cilium_tpu.observe.trace import Tracer
+from cilium_tpu.pipeline import (Pipeline, PipelineClosed,
+                                 PipelineDeadlineExceeded, PipelineDrop,
+                                 PipelineError, PipelineUnavailable)
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.datapath import FakeDatapath
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.runtime.faults import FAULTS
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+from oracle import PacketRecord
+
+POLICY = [{
+    "endpointSelector": {"matchLabels": {"app": "web"}},
+    "egress": [{"toCIDR": ["10.0.0.0/8"],
+                "toPorts": [{"ports": [{"port": "443",
+                                        "protocol": "TCP"}]}]}],
+}]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def sub_batch(n_rows, start, n_valid=None):
+    b = empty_batch(n_rows)
+    b["sport"][:] = np.arange(start, start + n_rows, dtype=np.int32)
+    b["valid"][: n_rows if n_valid is None else n_valid] = True
+    return b
+
+
+class EchoDispatch:
+    """Records dispatched valid-row sports; echoes sport through reason."""
+
+    def __init__(self):
+        self.batches = []
+        self.gate = threading.Event()
+        self.gate.set()
+        self.fail_always = None      # exception type raised on every call
+
+    def __call__(self, batch, now):
+        self.gate.wait(timeout=30)
+        if self.fail_always is not None:
+            raise self.fail_always("backend down")
+        valid = np.asarray(batch["valid"])
+        self.batches.append(np.asarray(batch["sport"])[valid].tolist())
+        out = {
+            "allow": valid.copy(),
+            "reason": np.asarray(batch["sport"], np.int32).copy(),
+            "status": np.zeros(valid.shape[0], np.int32),
+            "remote_identity": np.zeros(valid.shape[0], np.int32),
+        }
+        return lambda: out
+
+    @property
+    def sports_seen(self):
+        return [s for b in self.batches for s in b]
+
+
+def guarded(d, **kw):
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("max_bucket", 16)
+    kw.setdefault("flush_ms", 1000.0)
+    kw.setdefault("restart_backoff_s", 0.01)
+    return Pipeline(d, **kw)
+
+
+# --------------------------------------------------------------------------- #
+class TestDeadlines:
+    def test_shed_at_ingest_while_worker_busy(self):
+        """A submission whose deadline passes while it queues behind a
+        slow dispatch is shed at ingest — the device never sees it."""
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = guarded(d)
+        try:
+            hog = pl.submit(sub_batch(4, start=0))       # wedges in dispatch
+            time.sleep(0.05)                             # hog reaches worker
+            stale = pl.submit(sub_batch(4, start=100), deadline_ms=10)
+            time.sleep(0.05)                             # deadline passes
+            d.gate.set()
+            with pytest.raises(PipelineDeadlineExceeded):
+                stale.result(timeout=5)
+            assert hog.result(timeout=5)["allow"].all()
+            assert 100 not in d.sports_seen              # never dispatched
+            s = pl.stats()
+            assert s["shed_total"] == 1
+            assert s["shed_reasons"] == {"ingest": 1}
+            assert pl.metrics.counters[
+                'pipeline_shed_total{reason="ingest"}'] == 1
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+    def test_shed_at_flush_masks_rows(self):
+        """A staged rider whose deadline expires before the bucket
+        dispatches is masked out of the bucket and rejected; co-staged
+        riders still serve."""
+        d = EchoDispatch()
+        pl = guarded(d, flush_ms=60_000.0)
+        try:
+            doomed = pl.submit(sub_batch(3, start=10), deadline_ms=30)
+            keeper = pl.submit(sub_batch(3, start=20))
+            time.sleep(0.08)                             # both staged; 10ms
+            assert pl.drain(timeout=5)                   # forces the flush
+            with pytest.raises(PipelineDeadlineExceeded):
+                doomed.result(timeout=1)
+            assert keeper.result(timeout=1)["reason"].tolist() == \
+                [20, 21, 22]
+            # the doomed rows were valid-masked out of the shared bucket
+            assert d.sports_seen == [20, 21, 22]
+            assert pl.stats()["shed_reasons"] == {"flush": 1}
+        finally:
+            pl.close(timeout=5)
+
+    def test_default_deadline_from_ctor(self):
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = guarded(d, deadline_ms=10)
+        try:
+            pl.submit(sub_batch(4, start=0))
+            time.sleep(0.05)
+            late = pl.submit(sub_batch(4, start=50))     # inherits 10ms
+            time.sleep(0.05)
+            d.gate.set()
+            with pytest.raises(PipelineDeadlineExceeded):
+                late.result(timeout=5)
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+    def test_shed_counter_renders_one_type_line(self):
+        d = EchoDispatch()
+        pl = guarded(d)
+        try:
+            pl.metrics.inc_counter('pipeline_shed_total{reason="ingest"}')
+            pl.metrics.inc_counter('pipeline_shed_total{reason="flush"}')
+            text = pl.metrics.render_prometheus()
+            assert text.count("# TYPE ciliumtpu_pipeline_shed_total "
+                              "counter") == 1
+            assert 'ciliumtpu_pipeline_shed_total{reason="flush"} 1' in text
+            assert 'ciliumtpu_pipeline_shed_total{reason="ingest"} 1' in text
+        finally:
+            pl.close(timeout=5)
+
+
+# --------------------------------------------------------------------------- #
+class TestCircuitBreaker:
+    def test_opens_fast_fails_probes_and_closes(self):
+        """The acceptance sequence: fail-always dispatch opens the breaker
+        after `threshold` attempts (not MAX_DISPATCH_RETRIES), submissions
+        then fail fast, and after the cooldown a half-open probe dispatch
+        closes it again — every transition traced and counted."""
+        d = EchoDispatch()
+        tracer = Tracer(sample_rate=1.0, capacity=512)
+        pl = guarded(d, breaker_threshold=5, breaker_cooldown_s=0.15,
+                     tracer=tracer)
+        try:
+            FAULTS.arm("pipeline.dispatch", mode="fail")   # every fire
+            first = pl.submit(sub_batch(4, start=0))
+            with pytest.raises(PipelineUnavailable):
+                first.result(timeout=10)
+            assert pl.dispatch_faults <= 6        # no 1000-retry burn
+            assert pl.breaker.state == "open"
+            assert pl.state() == "breaker-open"
+            # open: fail fast at admission, nothing reaches the worker
+            for _ in range(3):
+                with pytest.raises(PipelineUnavailable):
+                    pl.submit(sub_batch(4, start=8))
+            assert pl.stats()["unavailable_total"] >= 3
+            # cooldown elapses; the armed fault fails the half-open probe
+            time.sleep(0.2)
+            probe = pl.submit(sub_batch(4, start=16))
+            with pytest.raises(PipelineUnavailable):
+                probe.result(timeout=5)
+            assert pl.breaker.state == "open"     # probe failure re-opened
+            # disarm + cooldown: the next probe closes the breaker
+            FAULTS.disarm("pipeline.dispatch")
+            time.sleep(0.2)
+            ok = pl.submit(sub_batch(4, start=24))
+            assert ok.result(timeout=5)["reason"].tolist() == \
+                [24, 25, 26, 27]
+            assert pl.breaker.state == "closed"
+            assert pl.state() == "ok"
+            # observability: transitions counted + traced + gauged
+            m = pl.metrics
+            assert m.counters[
+                'pipeline_breaker_transitions_total{to="open"}'] == 2
+            assert m.counters[
+                'pipeline_breaker_transitions_total{to="half-open"}'] == 2
+            assert m.counters[
+                'pipeline_breaker_transitions_total{to="closed"}'] == 1
+            assert m.gauges["pipeline_breaker_state"] == 0
+            events = tracer.spans(limit=100, name="pipeline.breaker")
+            tos = [e["attrs"]["to"] for e in events]
+            assert tos.count("open") == 2 and tos.count("closed") == 1
+        finally:
+            FAULTS.reset()
+            pl.close(timeout=5)
+
+    def test_real_errors_feed_breaker_and_suppress_queued(self):
+        """Non-fault dispatch errors open the breaker too, and batches
+        already queued behind the failure are rejected fast (dispatch
+        suppressed) instead of hammering the sick backend."""
+        d = EchoDispatch()
+        d.fail_always = ValueError
+        pl = guarded(d, breaker_threshold=3, breaker_cooldown_s=30.0)
+        try:
+            tickets, fast_fails = [], 0
+            for i in range(6):
+                try:
+                    tickets.append(pl.submit(sub_batch(4, start=4 * i)))
+                except PipelineUnavailable:
+                    # the breaker can open while we are still submitting
+                    # (worker outpaces the producer): fail-fast at
+                    # admission is the same guarantee, earlier
+                    fast_fails += 1
+            assert pl.drain(timeout=10)
+            for t in tickets:
+                with pytest.raises(PipelineError):
+                    t.result(timeout=1)
+            assert pl.breaker.state == "open"
+            # only `threshold` dispatch attempts hit the backend; the rest
+            # were suppressed while open or failed fast at admission
+            assert pl.dispatch_errors == 3
+            assert d.batches == []
+            assert len(tickets) + fast_fails == 6
+        finally:
+            pl.close(timeout=5)
+
+    def test_finalize_faults_feed_breaker(self):
+        d = EchoDispatch()
+        pl = guarded(d, breaker_threshold=2, breaker_cooldown_s=30.0)
+        try:
+            FAULTS.arm("pipeline.finalize", mode="fail")
+            t1 = pl.submit(sub_batch(4, start=0))
+            with pytest.raises(PipelineError):
+                t1.result(timeout=5)
+            t2 = pl.submit(sub_batch(4, start=4))
+            with pytest.raises(PipelineError):
+                t2.result(timeout=5)
+            assert pl.breaker.state == "open"
+        finally:
+            FAULTS.reset()
+            pl.close(timeout=5)
+
+
+# --------------------------------------------------------------------------- #
+def pkt(src, dst, sp, dp, ep_id=1):
+    s16, _ = parse_addr(src)
+    d16, _ = parse_addr(dst)
+    return PacketRecord(s16, d16, sp, dp, C.PROTO_TCP, C.TCP_SYN, False,
+                        ep_id, C.DIR_EGRESS, C.HTTP_METHOD_ANY, b"")
+
+
+def fake_engine(**kw):
+    kw.setdefault("ct_capacity", 4096)
+    kw.setdefault("auto_regen", False)
+    kw.setdefault("batch_size", 64)
+    cfg = DaemonConfig(**kw)
+    return Engine(cfg, datapath=FakeDatapath(cfg))
+
+
+def unique_chunks(slot_of, n_chunks, rows, base=40000):
+    """Unique-flow SYN chunks (allowed and denied mix): under the CT
+    snapshot-batch semantics batch composition cannot change a unique
+    flow's verdict, so a serial engine classifying the same chunks is a
+    bit-exact oracle for whichever tickets resolve."""
+    chunks = []
+    for c in range(n_chunks):
+        recs = []
+        for r in range(rows):
+            sp = base + c * rows + r
+            dp = 443 if (c + r) % 3 else 80          # mix allow/deny
+            recs.append(pkt("192.168.1.10", f"10.0.{c % 200}.{r + 1}",
+                            sp, dp))
+        chunks.append(batch_from_records(recs, slot_of))
+    return chunks
+
+
+OUT_KEYS = ("allow", "reason", "status", "remote_identity", "svc",
+            "nat_dst", "nat_dport", "rnat", "rnat_src", "rnat_sport")
+
+
+class TestWatchdogRestart:
+    def test_parity_across_forced_restart(self):
+        """The acceptance pin: a hang-mode fault wedges the worker
+        mid-stream → the watchdog restarts it. Every pre-stall ticket
+        resolves or is rejected (none blocks forever), and every verdict
+        that resolves — pre-stall survivors and post-restart submissions —
+        is bit-identical to the serial classify path on the same
+        submissions."""
+        ser = fake_engine()
+        pipe = fake_engine(pipeline_min_bucket=16,
+                           pipeline_flush_ms=1.0,
+                           pipeline_stall_timeout_s=0.2,
+                           pipeline_restart_backoff_s=0.02,
+                           pipeline_max_restarts=3)
+        for eng in (ser, pipe):
+            eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",),
+                             ep_id=1)
+            eng.apply_policy(POLICY)
+        slot_of = ser.active.snapshot.ep_slot_of
+        chunks = unique_chunks(slot_of, n_chunks=10, rows=5)
+        serial_outs = [ser.classify(dict(ch), now=100 + i)
+                       for i, ch in enumerate(chunks)]
+
+        FAULTS.arm("pipeline.dispatch", mode="hang", delay_s=2.0, times=1)
+        tickets = [pipe.submit(dict(ch), now=100 + i)
+                   for i, ch in enumerate(chunks)]
+        assert pipe.drain(timeout=30)
+        FAULTS.disarm("pipeline.dispatch")
+        # none blocks forever
+        assert all(t.done() for t in tickets)
+        rejected = resolved = 0
+        for i, t in enumerate(tickets):
+            try:
+                got = t.result(timeout=1)
+            except PipelineError:
+                rejected += 1
+                continue
+            resolved += 1
+            for k in OUT_KEYS:
+                np.testing.assert_array_equal(
+                    got[k], serial_outs[i][k],
+                    err_msg=f"pre-stall chunk {i} field {k} diverged")
+        assert rejected >= 1, "the hang never wedged anything"
+        stats = pipe.pipeline_stats()
+        assert stats["restarts"] == 1
+
+        # post-restart submissions: bit-identical to serial on the same
+        # submissions (FIFO contract survives the restart)
+        post = unique_chunks(slot_of, n_chunks=6, rows=5, base=50000)
+        post_serial = [ser.classify(dict(ch), now=200 + i)
+                       for i, ch in enumerate(post)]
+        post_tickets = [pipe.submit(dict(ch), now=200 + i)
+                        for i, ch in enumerate(post)]
+        assert pipe.drain(timeout=30)
+        for i, (t, want) in enumerate(zip(post_tickets, post_serial)):
+            got = t.result(timeout=5)
+            for k in OUT_KEYS:
+                np.testing.assert_array_equal(
+                    got[k], want[k],
+                    err_msg=f"post-restart chunk {i} field {k} diverged")
+        assert pipe.pipeline_stats()["state"] == "ok"
+        pipe.stop()
+        ser.stop()
+
+    def test_hard_fail_past_restart_budget(self):
+        """Each malformed submission crashes the worker; past
+        max_restarts the pipeline goes hard-failed: everything rejected,
+        submit fails fast, drain doesn't hang."""
+        d = EchoDispatch()
+        pl = guarded(d, max_restarts=1)
+        bad = {"valid": np.ones(3, bool),
+               "sport": np.arange(3, dtype=np.int32)}
+        for _ in range(2):                    # restart 1, then hard-fail
+            t = pl.submit(dict(bad))
+            with pytest.raises(PipelineError):
+                t.result(timeout=5)
+            time.sleep(0.05)                  # let the restart land
+        deadline = time.monotonic() + 5
+        while pl.state() != "failed" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pl.state() == "failed"
+        with pytest.raises(PipelineUnavailable):
+            pl.submit(sub_batch(4, start=0))
+        assert pl.drain(timeout=5)
+        assert pl.stats()["outstanding"] == 0
+        assert pl.metrics.counters["pipeline_hard_failures_total"] == 1
+        pl.close(timeout=5)
+
+    def test_close_timeout_sweeps_stranded_tickets(self):
+        """close(timeout) with a wedged worker must not strand
+        outstanding tickets: after the join timeout they are swept and
+        rejected, and the fenced worker waking later is harmless."""
+        d = EchoDispatch()
+        d.gate.clear()                        # wedge inside dispatch_fn
+        pl = guarded(d, queue_batches=32)
+        tickets = [pl.submit(sub_batch(4, start=4 * i)) for i in range(5)]
+        t0 = time.monotonic()
+        pl.close(timeout=0.3)
+        assert time.monotonic() - t0 < 5
+        for t in tickets:
+            assert t.done()
+            with pytest.raises(PipelineError):
+                t.result(timeout=1)
+        assert pl.stats()["outstanding"] == 0
+        d.gate.set()                          # wake the fenced worker
+        time.sleep(0.1)                       # it must exit without damage
+        with pytest.raises(PipelineClosed):
+            pl.submit(sub_batch(4, start=0))
+
+    def test_close_without_timeout_never_hangs_on_wedged_worker(self):
+        """close() with the default timeout=None on a wedged worker must
+        still terminate: the watchdog's shutdown sweep fences the stuck
+        thread and rejects the outstanding tickets."""
+        d = EchoDispatch()
+        d.gate.clear()                        # wedge inside dispatch_fn
+        pl = guarded(d, stall_timeout_s=0.2)
+        tickets = [pl.submit(sub_batch(4, start=4 * i)) for i in range(3)]
+        t0 = time.monotonic()
+        pl.close()                            # unbounded join would hang
+        assert time.monotonic() - t0 < 10
+        for t in tickets:
+            assert t.done()
+            with pytest.raises(PipelineError):
+                t.result(timeout=1)
+        assert pl.stats()["outstanding"] == 0
+        d.gate.set()
+
+    def test_close_without_timeout_after_hard_fail_zombie(self):
+        """A hard-failed pipeline whose last wedged worker thread is still
+        alive (stuck in the device call) must not hang close(timeout=None)
+        — the fenced worker can never drain, so close stops waiting on
+        it."""
+        d = EchoDispatch()
+        d.gate.clear()                        # wedge inside dispatch_fn
+        pl = guarded(d, stall_timeout_s=0.05, max_restarts=0)
+        t = pl.submit(sub_batch(4, start=0))
+        with pytest.raises(PipelineError):
+            t.result(timeout=10)              # first stall → hard-fail
+        deadline = time.monotonic() + 5
+        while pl.state() != "failed" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pl.state() == "failed"
+        t0 = time.monotonic()
+        pl.close()                            # zombie alive; must return
+        assert time.monotonic() - t0 < 10
+        d.gate.set()
+
+    def test_engine_health_folds_pipeline_state(self):
+        eng = fake_engine(pipeline_breaker_threshold=3,
+                          pipeline_breaker_cooldown_s=0.2)
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        slot_of = eng.active.snapshot.ep_slot_of
+        assert "pipeline" not in eng.health()          # not started yet
+        FAULTS.arm("pipeline.dispatch", mode="fail")
+        t = eng.submit(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40000, 443)], slot_of),
+            now=100)
+        with pytest.raises(PipelineUnavailable):
+            t.result(timeout=10)
+        h = eng.health()
+        assert h["state"] == C.HEALTH_DEGRADED
+        assert h["pipeline"]["state"] == "breaker-open"
+        assert h["pipeline"]["breaker"]["consecutive_failures"] >= 3
+        text = eng.render_metrics()
+        assert 'pipeline_breaker_transitions_total{to="open"} 1' in text
+        assert "ciliumtpu_pipeline_state 1" in text
+        FAULTS.disarm("pipeline.dispatch")
+        time.sleep(0.25)
+        out = eng.submit(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40001, 443)], slot_of),
+            now=101).result(timeout=10)
+        assert out["allow"].all()
+        h = eng.health()
+        assert h["state"] == C.HEALTH_OK
+        assert h["pipeline"]["state"] == "ok"
+        eng.stop()
+
+
+# --------------------------------------------------------------------------- #
+class TestShutdownRaces:
+    def test_drain_racing_close(self):
+        """drain() waiters must resolve when close() lands concurrently —
+        no deadlock, accounting consistent."""
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = guarded(d, queue_batches=32)
+        for i in range(6):
+            pl.submit(sub_batch(4, start=4 * i))
+        results = {}
+
+        def drainer():
+            results["drained"] = pl.drain(timeout=10)
+
+        th = threading.Thread(target=drainer)
+        th.start()
+        time.sleep(0.05)
+        d.gate.set()
+        pl.close(timeout=10)                   # clean close: work completes
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert results["drained"] is True
+        assert pl.stats()["outstanding"] == 0
+
+    def test_drain_racing_wedged_close(self):
+        """Same race with a wedged worker: the close-timeout sweep must
+        release the drain waiter (outstanding reaches zero)."""
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = guarded(d, queue_batches=32)
+        for i in range(4):
+            pl.submit(sub_batch(4, start=4 * i))
+        results = {}
+
+        def drainer():
+            results["drained"] = pl.drain(timeout=10)
+
+        th = threading.Thread(target=drainer)
+        th.start()
+        time.sleep(0.05)
+        pl.close(timeout=0.3)                  # worker still gated: sweep
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert results["drained"] is True      # everything rejected == done
+        assert pl.stats()["outstanding"] == 0
+        d.gate.set()
+
+    def test_submit_blocked_at_admission_sees_close(self):
+        """A producer blocked at a full admission queue must get
+        PipelineClosed when close() lands — and the never-admitted
+        submission must not leak into _outstanding (drain still
+        terminates, outstanding reaches zero)."""
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = guarded(d, queue_batches=1, block_timeout_s=30.0)
+        first = pl.submit(sub_batch(4, start=0))     # worker picks this up
+        time.sleep(0.05)
+        second = pl.submit(sub_batch(4, start=4))    # fills the queue
+        errors = {}
+
+        def blocked_submit():
+            try:
+                pl.submit(sub_batch(4, start=8))
+            except BaseException as e:               # noqa: BLE001
+                errors["exc"] = e
+
+        th = threading.Thread(target=blocked_submit)
+        th.start()
+        time.sleep(0.1)
+        assert th.is_alive()                         # parked at admission
+        d.gate.set()
+        pl.close(timeout=10)
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert isinstance(errors.get("exc"), PipelineClosed)
+        # the two accepted submissions completed; the blocked one never
+        # entered accounting
+        assert first.result(timeout=1)["allow"].all()
+        assert second.result(timeout=1)["allow"].all()
+        assert pl.stats()["outstanding"] == 0
+        assert pl.drain(timeout=1)
+
+
+# --------------------------------------------------------------------------- #
+class TestHangFaultMode:
+    def test_hang_is_bounded_and_disarm_releases(self):
+        FAULTS.arm("pipeline.dispatch", mode="hang", delay_s=5.0)
+        t0 = time.monotonic()
+        released = {}
+
+        def firer():
+            FAULTS.fire("pipeline.dispatch")
+            released["after"] = time.monotonic() - t0
+
+        th = threading.Thread(target=firer)
+        th.start()
+        time.sleep(0.1)
+        FAULTS.disarm("pipeline.dispatch")     # cooperative early release
+        th.join(timeout=5)
+        assert not th.is_alive()
+        assert released["after"] < 1.0
+        assert FAULTS.stats()["pipeline.dispatch"]["fired"] >= 1
+
+    def test_hang_cap_is_clamped(self):
+        from cilium_tpu.runtime.faults import HANG_HARD_CAP_S, FaultSpec
+        spec = FaultSpec(mode="hang", delay_s=10_000.0)
+        assert spec.delay_s == 10_000.0        # spec keeps the ask...
+        assert HANG_HARD_CAP_S <= 30.0         # ...fire() clamps the stall
+
+    def test_new_points_registered_and_env_grammar(self):
+        from cilium_tpu.runtime.faults import POINTS, FaultInjector
+        assert "pipeline.finalize" in POINTS
+        assert "datapath.transfer" in POINTS
+        inj = FaultInjector(env={})
+        assert inj.load_spec("pipeline.finalize=hang:0.05;"
+                             "datapath.transfer=fail:2") == 2
+        armed = inj.armed()
+        assert armed["pipeline.finalize"].mode == "hang"
+        assert armed["pipeline.finalize"].delay_s == 0.05
+        assert armed["datapath.transfer"].times == 2
+
+
+# --------------------------------------------------------------------------- #
+class TestServingAPI:
+    @pytest.fixture
+    def live_engine(self, tmp_path):
+        sock = str(tmp_path / "guard.sock")
+        eng = fake_engine(api_socket=sock,
+                          pipeline_breaker_threshold=3,
+                          pipeline_breaker_cooldown_s=30.0,
+                          pipeline_request_timeout_s=5.0)
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(POLICY)
+        eng.regenerate()
+        eng.start_background()
+        yield eng, sock
+        eng.stop()
+        FAULTS.reset()
+
+    def test_classify_route_serves_verdicts(self, live_engine):
+        from cilium_tpu.runtime.api import UnixAPIClient
+        eng, sock = live_engine
+        code, doc = UnixAPIClient(sock).post("/v1/classify", {"records": [
+            {"src": "192.168.1.10", "dst": "10.1.2.3", "sport": 40000,
+             "dport": 443, "proto": "TCP", "ep": 1},
+            {"src": "192.168.1.10", "dst": "10.1.2.3", "sport": 40001,
+             "dport": 80, "proto": "TCP", "ep": 1},
+        ]})
+        assert code == 200 and doc["count"] == 2
+        assert doc["verdicts"][0]["allow"] is True
+        assert doc["verdicts"][1]["allow"] is False
+        # parity with the serial path on the same flows
+        out = eng.classify(batch_from_records(
+            [pkt("192.168.1.10", "10.1.2.3", 40002, 443)],
+            eng.active.snapshot.ep_slot_of))
+        assert bool(out["allow"][0]) is True
+
+    def test_classify_route_maps_unavailable_to_503(self, live_engine):
+        from cilium_tpu.runtime.api import UnixAPIClient
+        eng, sock = live_engine
+        client = UnixAPIClient(sock)
+        FAULTS.arm("pipeline.dispatch", mode="fail")
+        rec = {"src": "192.168.1.10", "dst": "10.1.2.3", "sport": 41000,
+               "dport": 443, "proto": "TCP", "ep": 1}
+        code, doc = client.post("/v1/classify", {"records": [rec]})
+        assert code == 503 and doc["kind"] == "PipelineUnavailable"
+        # breaker now open: the next request fails fast, still 503 + body
+        code, doc = client.post("/v1/classify", {"records": [rec]})
+        assert code == 503 and "error" in doc
+        code, h = client.get("/v1/healthz")
+        assert code == 200 and h["pipeline"]["state"] == "breaker-open"
+        assert h["state"] == C.HEALTH_DEGRADED
+
+    def test_classify_route_validates_body(self, live_engine):
+        from cilium_tpu.runtime.api import UnixAPIClient
+        _eng, sock = live_engine
+        client = UnixAPIClient(sock)
+        code, doc = client.post("/v1/classify", {})
+        assert code == 400
+        code, doc = client.post("/v1/classify",
+                                {"records": [{"src": "10.0.0.1"}]})
+        assert code == 400 and "missing" in doc["error"]
+
+    def test_serving_error_mapping(self):
+        from cilium_tpu.runtime.api import serving_error
+        assert serving_error(PipelineDrop("q full"))[0] == 429
+        assert serving_error(PipelineDeadlineExceeded("late"))[0] == 429
+        assert serving_error(PipelineUnavailable("open"))[0] == 503
+        assert serving_error(PipelineClosed("closed"))[0] == 503
+        assert serving_error(TimeoutError("slow"))[0] == 503
+        assert serving_error(PipelineError("other"))[0] == 503
+        assert serving_error(ValueError("bug")) is None
+
+
+# --------------------------------------------------------------------------- #
+@pytest.mark.slow
+class TestWatchdogSoak:
+    def test_soak_10k_submissions_through_forced_restarts(self):
+        """`make chaos` tail: 10k direct-dispatch submissions with a
+        hang fault tripping three times mid-stream (three watchdog
+        restarts). Every ticket resolves or is rejected, every resolved
+        row reached the dispatch function exactly once and in submission
+        order, and the pipeline ends healthy."""
+        d = EchoDispatch()
+        pl = Pipeline(d, min_bucket=4, max_bucket=16, flush_ms=0.5,
+                      queue_batches=256, block_timeout_s=30.0,
+                      stall_timeout_s=0.1, restart_backoff_s=0.01,
+                      max_restarts=10)
+        FAULTS.arm("pipeline.dispatch", mode="hang", delay_s=0.6, times=3)
+        n_sub = 10_000
+        tickets = []
+        for i in range(n_sub):
+            tickets.append(pl.submit(sub_batch(4, start=4 * i)))
+        assert pl.drain(timeout=180)
+        FAULTS.disarm("pipeline.dispatch")
+        assert all(t.done() for t in tickets)
+        expected = []
+        rejected = 0
+        for i, t in enumerate(tickets):
+            try:
+                t.result(timeout=1)
+                expected.extend(range(4 * i, 4 * i + 4))
+            except PipelineError:
+                rejected += 1
+        assert d.sports_seen == expected, \
+            "resolved rows lost, reordered, or double-dispatched"
+        stats = pl.stats()
+        assert stats["restarts"] == 3
+        assert rejected >= 3            # at least one window per stall
+        assert rejected < n_sub // 10   # ...but the storm stayed contained
+        assert stats["state"] == "ok"
+        assert stats["outstanding"] == 0
+        pl.close(timeout=10)
