@@ -9,7 +9,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
-        shim bench clean
+        ingest-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -33,10 +33,19 @@ chaos-pipeline:
 # pytest, plus the slow-marked 10k-submission watchdog soak. A fast subset
 # on the fake datapath runs in tier-1 (tests/test_faults.py,
 # tests/test_pipeline_guard.py via chaos-pipeline).
-chaos: chaos-pipeline
+chaos: chaos-pipeline ingest-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow
+
+# Zero-copy-ingestion gate (shim/feeder.py + the out= pack kernels): the
+# tier-1 feeder/pack subset (poll-buffer reuse parity, FIFO verdict order
+# through mock rings incl. an armed shim.rx_ring storm, fail-closed on
+# pipeline rejection, the tracemalloc steady-state zero-alloc soak) plus
+# the slow-marked 10k-frame feeder soak with faults armed the whole run.
+ingest-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_feeder.py tests/test_kernels.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_feeder.py -q -m slow
 
 # Ingestion-pipeline gate (pipeline/scheduler.py): the tier-1 pipeline
 # subset (ordering, backpressure, deadline flush, fault retries, clean

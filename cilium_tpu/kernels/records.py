@@ -33,6 +33,22 @@ def empty_batch(n: int) -> BatchArrays:
     }
 
 
+def reset_batch_rows(b: BatchArrays, start: int, stop: int) -> None:
+    """Restore rows [start:stop] of a reused batch dict to the
+    ``empty_batch`` defaults (optional shim-side ``_*`` columns included).
+    Every buffer-reuse site (staging-ring flush tails, reusable poll
+    buffers) goes through here so a future column with a non-zero default
+    cannot silently diverge between them — stale rows leaking into the
+    wire-format probes is exactly the bug class this prevents."""
+    for k, col in b.items():
+        if k == "valid":
+            col[start:stop] = False
+        elif k == "http_method":
+            col[start:stop] = C.HTTP_METHOD_ANY
+        else:
+            col[start:stop] = 0
+
+
 def _addr_words(addr16: bytes) -> np.ndarray:
     return np.frombuffer(addr16, dtype=">u4").astype(np.uint32)
 
@@ -113,6 +129,22 @@ PACK_WORDS = 11
 PACK_WORDS_L7 = PACK_WORDS + C.L7_PATH_MAXLEN // 4
 
 
+def _out_view(out: Optional[np.ndarray], n: int, words: int) -> np.ndarray:
+    """Resolve the ``out=`` contract shared by every pack kernel: a
+    caller-owned uint32 buffer with >= n rows of exactly ``words`` columns
+    (the staging ring preallocates at max_bucket rows and packs into the
+    [:n] prefix). Returns the [:n] view to fill, or a fresh allocation when
+    the caller passed none."""
+    if out is None:
+        return np.empty((n, words), dtype=np.uint32)
+    if (out.dtype != np.uint32 or out.ndim != 2 or out.shape[0] < n
+            or out.shape[1] != words):
+        raise ValueError(
+            f"pack out= buffer mismatch: need uint32 [>={n}, {words}], "
+            f"got {out.dtype} {out.shape}")
+    return out[:n]
+
+
 def _path_words_of(paths: np.ndarray) -> int:
     """Smallest power-of-two word count covering the longest path in
     ``paths`` [N, 64]. L7 throughput is transfer-bound and most HTTP paths
@@ -130,11 +162,15 @@ def _path_words_for(b: BatchArrays) -> int:
 
 
 def pack_batch(b: BatchArrays, l7: Optional[bool] = None,
-               path_words: Optional[int] = None) -> np.ndarray:
+               path_words: Optional[int] = None,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
     """Pack a batch dict → [N, 11] (or [N, 11+path_words] when l7) uint32.
     ``l7=None`` auto-detects: include the path block iff any record carries
     L7 tokens. ``path_words`` (power of two ≤ 16) sizes the path block;
-    default = smallest power of two covering the batch's longest path."""
+    default = smallest power of two covering the batch's longest path.
+    ``out=`` writes into a caller-owned buffer (see ``_out_view``) instead
+    of allocating — the staging ring's steady-state zero-alloc path; the
+    wire is bit-identical either way."""
     if l7 is None:
         l7 = bool((b["http_method"] != C.HTTP_METHOD_ANY).any()
                   or b["http_path"].any())
@@ -147,7 +183,7 @@ def pack_batch(b: BatchArrays, l7: Optional[bool] = None,
     else:
         path_words = 0
     n = b["valid"].shape[0]
-    out = np.empty((n, PACK_WORDS + path_words), dtype=np.uint32)
+    out = _out_view(out, n, PACK_WORDS + path_words)
     out[:, 0:4] = b["src"]
     out[:, 4:8] = b["dst"]
     out[:, 8] = (b["sport"].astype(np.uint32) << 16) \
@@ -179,14 +215,16 @@ PACK4_WORDS = 4
 PACK4_EP_SLOT_MAX = (1 << 14) - 1
 
 
-def pack_batch_v4(b: BatchArrays) -> np.ndarray:
-    """Pack a v4-only, L7-free batch dict → [N, 4] uint32."""
+def pack_batch_v4(b: BatchArrays,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pack a v4-only, L7-free batch dict → [N, 4] uint32. ``out=`` fills
+    a caller-owned buffer in place (bit-identical wire, no allocation)."""
     if b["is_v6"].any():
         raise ValueError("pack_batch_v4: batch contains v6 records")
     if (b["ep_slot"] > PACK4_EP_SLOT_MAX).any():
         raise ValueError("pack_batch_v4: ep_slot exceeds 14-bit compact cap")
     n = b["valid"].shape[0]
-    out = np.empty((n, PACK4_WORDS), dtype=np.uint32)
+    out = _out_view(out, n, PACK4_WORDS)
     out[:, 0] = b["src"][:, 3]
     out[:, 1] = b["dst"][:, 3]
     out[:, 2] = (b["sport"].astype(np.uint32) << 16) \
@@ -246,17 +284,21 @@ def _pack_path_dict(paths: np.ndarray, path_words: Optional[int],
 
 
 def pack_batch_l7dict(b: BatchArrays, path_words: Optional[int] = None,
-                      min_rows: int = 1, force_full: bool = False
+                      min_rows: int = 1, force_full: bool = False,
+                      out: Optional[np.ndarray] = None
                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Pack an L7 batch as (wire, path_dict). Picks the 5-word v4-compact
     wire when the batch qualifies, else the 12-word full wire
     (``force_full`` pins the full wire so serving paths don't flap formats
-    batch-to-batch)."""
+    batch-to-batch). ``out=`` fills a caller-owned wire buffer in place
+    (its width must match the variant this batch selects; the path dict is
+    always fresh — ``np.unique`` allocates regardless, and the upload layer
+    dedups re-transfers by content instead)."""
     dict_words, idx = _pack_path_dict(b["http_path"], path_words, min_rows)
     n = b["valid"].shape[0]
     if not force_full and not b["is_v6"].any() \
             and not (b["ep_slot"] > PACK4_EP_SLOT_MAX).any():
-        wire = np.empty((n, PACK4_L7_WORDS), dtype=np.uint32)
+        wire = _out_view(out, n, PACK4_L7_WORDS)
         wire[:, 0] = b["src"][:, 3]
         wire[:, 1] = b["dst"][:, 3]
         wire[:, 2] = (b["sport"].astype(np.uint32) << 16) \
@@ -269,8 +311,8 @@ def pack_batch_l7dict(b: BatchArrays, path_words: Optional[int] = None,
         wire[:, 4] = (b["http_method"].astype(np.uint32) << 24) \
             | idx.astype(np.uint32)
         return wire, dict_words
-    wire = np.empty((n, PACK_L7DICT_WORDS), dtype=np.uint32)
-    wire[:, :PACK_WORDS] = pack_batch(b, l7=False)
+    wire = _out_view(out, n, PACK_L7DICT_WORDS)
+    pack_batch(b, l7=False, out=wire[:, :PACK_WORDS])
     wire[:, PACK_WORDS] = idx.astype(np.uint32)
     return wire, dict_words
 

@@ -79,7 +79,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.kernels.records import empty_batch, reset_batch_rows
 from cilium_tpu.observe.trace import TRACER, Tracer
 from cilium_tpu.pipeline.guard import (PIPELINE_STATES, CircuitBreaker,
                                        PipelineClosed,
@@ -221,6 +221,32 @@ class _Inflight:
         self.buf_idx = buf_idx
 
 
+class _StageBuf:
+    """One staging-ring slot: a preallocated max_bucket-row column batch
+    plus cached per-bucket prefix views, so a steady-state flush allocates
+    nothing — neither columns nor the view dict handed to dispatch (the
+    view dict for each power-of-two bucket is built once per buffer and
+    reused; a buffer is never rewritten while its views are in flight,
+    which is exactly the ring's recycle discipline)."""
+
+    __slots__ = ("cols", "_views")
+
+    def __init__(self, max_bucket: int):
+        self.cols = empty_batch(max_bucket)
+        # shim-fed submissions carry raw endpoint ids so the dispatch-time
+        # slot re-mapping survives coalescing; rows from producers without
+        # the column stage as 0 (= "no raw id", left untouched downstream)
+        self.cols["_ep_raw"] = np.zeros((max_bucket,), dtype=np.int64)
+        self._views: Dict[int, Dict[str, np.ndarray]] = {}
+
+    def view(self, bucket: int) -> Dict[str, np.ndarray]:
+        v = self._views.get(bucket)
+        if v is None:
+            v = {k: col[:bucket] for k, col in self.cols.items()}
+            self._views[bucket] = v
+        return v
+
+
 class Pipeline:
     """The scheduler. ``dispatch_fn(batch, now)`` must enqueue one batch and
     return a zero-arg finalize callable yielding the out dict — the Engine
@@ -295,7 +321,7 @@ class Pipeline:
         self._hb: Optional[Tuple[float, str, int, int]] = None
 
         # worker-owned (no lock): staging ring + inflight window
-        self._buffers = [empty_batch(max_bucket)
+        self._buffers = [_StageBuf(max_bucket)
                          for _ in range(inflight + 1)]
         self._free_bufs: List[int] = list(range(len(self._buffers)))
         self._stage_buf: Optional[int] = None
@@ -585,6 +611,10 @@ class Pipeline:
             "queue_depth": queue_depth,
             "staged_rows": pub.get("staged_rows", 0),
             "inflight": pub.get("inflight", 0),
+            "staging_free": pub.get("staging_free",
+                                    self._inflight_max + 1),
+            "staging_slots": pub.get("staging_slots",
+                                     self._inflight_max + 1),
             "admission_drops": admission_drops,
             "dispatched_batches": pub.get("dispatched_batches",
                                           self.dispatched_batches),
@@ -701,9 +731,14 @@ class Pipeline:
             self.metrics.set_gauge("pipeline_queue_depth", 0)
         # fresh staging ring: the old buffers may still be referenced by
         # the fenced-off worker — never reuse them
-        self._buffers = [empty_batch(self._max_bucket)
+        self._buffers = [_StageBuf(self._max_bucket)
                          for _ in range(self._inflight_max + 1)]
         self._free_bufs = list(range(len(self._buffers)))
+        # the gauge is otherwise only touched in acquire/recycle: without
+        # this it would report the wedged worker's last value (usually 0)
+        # through the whole recovery window
+        self.metrics.set_gauge("pipeline_staging_free",
+                               len(self._free_bufs))
         self._stage_buf = None
         self._staged_rows = 0
         self._staged_slices = []
@@ -937,11 +972,26 @@ class Pipeline:
             self._stage_deadline = t.submitted_mono + self._flush_s
             self._stage_now = None
         valid_idx = np.nonzero(np.asarray(sub.batch["valid"]))[0]
-        buf = self._buffers[self._stage_buf]
+        buf = self._buffers[self._stage_buf].cols
         pos = self._staged_rows
         with self.tracer.span(t.trace_id, "pipeline.microbatch", rows=m):
-            for k, col in buf.items():
-                col[pos:pos + m] = np.asarray(sub.batch[k])[valid_idx]
+            # pipeline.stage_write: just the column writes into the pinned
+            # staging slot — the per-stage attribution point the ingest
+            # bench reads (microbatch additionally covers valid_idx/admin)
+            with self.tracer.span(t.trace_id, "pipeline.stage_write",
+                                  rows=m, slot=self._stage_buf):
+                for k, col in buf.items():
+                    if k.startswith("_"):
+                        # optional shim-side column: absent in non-shim
+                        # submissions → 0 ("no raw id")
+                        src = sub.batch.get(k)
+                        if src is None:
+                            col[pos:pos + m] = 0
+                            continue
+                    else:
+                        src = sub.batch[k]   # required: missing → crash →
+                        #                      supervised reject (pinned)
+                    col[pos:pos + m] = np.asarray(src)[valid_idx]
         if self._stage_now is None:
             self._stage_now = sub.now
         self._staged_slices.append(_Slice(t, valid_idx, pos))
@@ -954,7 +1004,8 @@ class Pipeline:
         if not self._staged_slices:
             return
         buf_idx = self._stage_buf
-        buf = self._buffers[buf_idx]
+        stage = self._buffers[buf_idx]
+        buf = stage.cols
         rows = self._staged_rows
         slices = self._staged_slices
         now = self._stage_now
@@ -985,10 +1036,15 @@ class Pipeline:
             return
         n_valid = sum(len(sl.valid_idx) for sl in live)
         bucket = max(self._min_bucket, _next_pow2(rows))
-        buf["valid"][rows:bucket] = False    # reused buffer: mask stale rows
-        view = {k: col[:bucket] for k, col in buf.items()}
-        self._dispatch(view, now, live, bucket, n_valid, reason, buf_idx,
-                       gen)
+        if rows < bucket:
+            # reused buffer: restore the empty-batch defaults on the tail,
+            # not just the valid mask — stale v6/L7/_ep_raw content from an
+            # earlier, larger flush would otherwise poison the datapath's
+            # wire-format probes (sticking the wide wire forever) and trip
+            # the strict v6 check in the compact pack kernel
+            reset_batch_rows(buf, rows, bucket)
+        self._dispatch(stage.view(bucket), now, live, bucket, n_valid,
+                       reason, buf_idx, gen)
 
     def _dispatch(self, batch: Dict[str, np.ndarray], now: Optional[int],
                   slices: List[_Slice], bucket_rows: int, n_valid: int,
@@ -1160,6 +1216,8 @@ class Pipeline:
             "fill_rows": self._fill_rows,
             "bucket_rows": self._bucket_rows,
             "inflight": len(self._inflight),
+            "staging_free": len(self._free_bufs),
+            "staging_slots": len(self._buffers),
             "dispatched_batches": self.dispatched_batches,
             "completed_batches": self.completed_batches,
         }
@@ -1171,11 +1229,17 @@ class Pipeline:
         while not self._free_bufs:
             self._check_gen(gen)
             self._finalize_oldest(gen)
-        return self._free_bufs.pop()
+        idx = self._free_bufs.pop()
+        # staging-ring occupancy: free slots left after this acquire (0 =
+        # every slot staged or in flight — the host is the bottleneck)
+        self.metrics.set_gauge("pipeline_staging_free", len(self._free_bufs))
+        return idx
 
     def _recycle(self, buf_idx: Optional[int]) -> None:
         if buf_idx is not None:
             self._free_bufs.append(buf_idx)
+            self.metrics.set_gauge("pipeline_staging_free",
+                                   len(self._free_bufs))
 
     def _reject_slices(self, slices: Sequence[_Slice], exc: BaseException,
                        buf_idx: Optional[int]) -> None:

@@ -168,6 +168,8 @@ def status_doc(engine: "Engine") -> Dict:
         "enforcement_mode": engine.ctx.enforcement_mode,
         # None until the ingestion pipeline has been started
         "pipeline": engine.pipeline_stats(),
+        # None until a shim feeder is attached (Engine.start_feeder)
+        "feeder": engine.feeder_stats(),
         # None until the autotune controller has run against a pipeline
         "autotune": engine.autotune_status(),
         "trace": engine.tracer.stats(),

@@ -42,6 +42,13 @@ class DaemonConfig:
     # repository changelog instead of full recompiles (geometry changes
     # still fall back to a full build — compile/incremental.py gates)
     incremental: bool = True
+    # --- zero-copy ingestion (kernels/records.py out= + shim/feeder.py) ---
+    # in-place pack into preallocated wire rings + L7 path-dict upload
+    # cache (JITDatapath); False restores per-batch allocation
+    zero_copy_ingest: bool = True
+    ingest_pool_batches: int = 4        # feeder harvest buffers in flight
+    ingest_poll_budget: int = 256       # rx descriptors per afxdp_poll
+    ingest_idle_sleep_s: float = 0.0005  # feeder park when rings are empty
     # --- ingestion pipeline (pipeline/scheduler.py) ---
     pipeline_queue_batches: int = 64    # bounded submission queue (batches)
     pipeline_admission: str = "block"   # block (up to timeout) | drop
@@ -103,6 +110,11 @@ class DaemonConfig:
         if self.pipeline_inflight < 1 or self.pipeline_queue_batches < 1:
             raise ValueError(
                 "pipeline_inflight and pipeline_queue_batches must be >= 1")
+        if self.ingest_pool_batches < 1 or self.ingest_poll_budget < 1:
+            raise ValueError(
+                "ingest_pool_batches and ingest_poll_budget must be >= 1")
+        if self.ingest_idle_sleep_s < 0:
+            raise ValueError("ingest_idle_sleep_s must be >= 0")
         if self.pipeline_deadline_ms < 0:
             raise ValueError("pipeline_deadline_ms must be >= 0 (0 = none)")
         if self.pipeline_request_timeout_s <= 0:

@@ -38,6 +38,11 @@ from cilium_tpu.utils import constants as C
 
 OutArrays = Dict[str, np.ndarray]
 
+#: clean (non-v6, compact-slot) batches required before place() may narrow
+#: a widened wire format: narrowing under sustained wide traffic would
+#: retrace every regen (the shape-flapping the sticky flags exist to stop)
+WIRE_RESET_CLEAN_BATCHES = 64
+
 CT_SCHEMA_KEYS = frozenset(
     ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev", "rev_nat"))
 
@@ -204,14 +209,80 @@ class JITDatapath(DatapathBackend):
         # wire-format stickiness: each (format, shape) is a separate XLA
         # trace (seconds), so per-batch content must not flap the choice —
         # once L7/v6 traffic is seen the wider format stays, and L7 dict
-        # geometry (path words, dict rows) only grows
+        # geometry (path words, dict rows) only grows. place() resets the
+        # flags when a NEW snapshot provably has no L7/v6 surface, so a
+        # transient burst doesn't tax every future batch forever.
         self._wire_l7 = False
         self._wire_wide = False        # v6 or >14-bit ep_slot seen
         self._l7_path_words = 1
         self._l7_dict_rows = 1
+        # zero-copy staging: a checkout/return pool of wire buffers the
+        # pack kernels fill in place, keyed by (rows, words). A buffer may
+        # be aliased by the backend until its batch finalizes
+        # (device_put/asarray can be zero-copy or async on some backends),
+        # so a buffer returns to the pool only in ITS OWN batch's finalize
+        # — never by rotation, which dispatch retries could wrap early. A
+        # pool miss allocates (steady state refills the pool; fault storms
+        # just shed buffers to the GC); only power-of-two row counts (the
+        # serving shapes) are pooled, so arbitrary control-plane batch
+        # sizes (health probes, pcap tails) can't grow it unboundedly.
+        self._pack_lock = threading.Lock()
+        self._wire_pool_cap = max(4, self.config.pipeline_inflight + 2)
+        self._wire_pool: Dict[Tuple[int, int], list] = {}
+        # batches since the last v6/wide-slot batch: the place() narrowing
+        # only fires after a clean run, so steady v6 traffic can never
+        # reset-flap the wire shape across regens
+        self._batches_since_wide = 0
+        # L7 path-dict upload cache: real traffic repeats the same path set
+        # batch after batch — an unchanged dict is never re-transferred
+        self._path_dict_host: Optional[np.ndarray] = None
+        self._path_dict_dev = None
+        # attribution counters (Engine surfaces them as gauges)
+        self.pack_stats: Dict[str, int] = {
+            "pack_inplace": 0,       # packed into a staging-ring buffer
+            "pack_fallback": 0,      # allocated (sharded path, or disabled)
+            "upload_cache_hits": 0,  # path dict served from device cache
+            "upload_cache_misses": 0,
+            "wire_flag_resets": 0,   # place() narrowed the wire format
+        }
+
+    def _maybe_reset_wire_flags(self, snap: PolicySnapshot) -> None:
+        """Un-stick the widened wire formats when the NEW snapshot provably
+        has no surface that needs them: with zero L7 rule sets, http tokens
+        cannot affect any verdict (no mapstate cell references an L7 set —
+        and widening is policy-gated, so the flag cannot re-stick while the
+        surface stays empty), and with no v6 prefixes + all ep slots under
+        the compact cap, the 4-word v4 wire is sufficient. The wide reset
+        additionally requires WIRE_RESET_CLEAN_BATCHES batches without v6
+        traffic, so sustained v6 flows can never reset-flap the shape
+        across regens — only a genuinely transient burst un-sticks."""
+        from cilium_tpu.kernels.records import PACK4_EP_SLOT_MAX
+        # under the pack lock: a concurrent classify_async reads/widens the
+        # same flags there — a reset landing between its widen and its
+        # format choice would mispack the in-flight batch
+        with self._pack_lock:
+            reset = False
+            if self._wire_l7 and snap.l7.n_sets == 0:
+                self._wire_l7 = False
+                self._l7_path_words = 1
+                self._l7_dict_rows = 1
+                self._path_dict_host = None
+                self._path_dict_dev = None
+                reset = True
+            # slots run 0..len-1, so the compact wire fits through
+            # len == PACK4_EP_SLOT_MAX + 1 inclusive
+            if self._wire_wide \
+                    and len(snap.ep_ids) - 1 <= PACK4_EP_SLOT_MAX \
+                    and self._batches_since_wide >= WIRE_RESET_CLEAN_BATCHES \
+                    and not any(":" in p for p in snap.ipcache):
+                self._wire_wide = False
+                reset = True
+            if reset:
+                self.pack_stats["wire_flag_resets"] += 1
 
     def place(self, snap: PolicySnapshot) -> Dict:
         jnp = self._jnp
+        self._maybe_reset_wire_flags(snap)
         if not self._sharded:
             return {k: jnp.asarray(v) for k, v in snap.tensors().items()}
         import jax
@@ -228,6 +299,7 @@ class JITDatapath(DatapathBackend):
         link instead of the whole image."""
         import jax
         jnp = self._jnp
+        self._maybe_reset_wire_flags(snap)
         tensors = snap.tensors()
         if self._sharded:
             from cilium_tpu.parallel.mesh import pad_snapshot_tensors
@@ -268,41 +340,84 @@ class JITDatapath(DatapathBackend):
         if self._sharded:
             return self._classify_async_sharded(placed, snap, batch, now)
         from cilium_tpu.kernels.records import (
-            PACK4_EP_SLOT_MAX, _path_words_of, pack_batch, pack_batch_l7dict,
-            pack_batch_v4)
+            PACK4_EP_SLOT_MAX, PACK4_L7_WORDS, PACK4_WORDS,
+            PACK_L7DICT_WORDS, PACK_WORDS, _path_words_of, pack_batch,
+            pack_batch_l7dict, pack_batch_v4)
         # observe/trace: the pack/transfer/compute split attaches to the
         # caller's current trace context (pipeline worker or
         # Engine.classify), whichever tracer instance set it
         tracer, trace_id = active_trace()
         with tracer.span(trace_id, "datapath.pack"):
-            b = {k: np.asarray(v) for k, v in batch.items()}
-            self._wire_l7 |= bool(
+            # already-columnar staged batches (the pipeline's staging ring,
+            # the shim feeder's harvest buffers) skip the per-batch dict
+            # copy; only mixed/jax-array pytrees still pay the conversion
+            if all(type(v) is np.ndarray for v in batch.values()):
+                b = batch
+            else:
+                b = {k: np.asarray(v) for k, v in batch.items()}
+            batch_l7 = bool(
                 (b["http_method"] != C.HTTP_METHOD_ANY).any()
                 or b["http_path"].any())
-            self._wire_wide |= bool(
+            batch_wide = bool(
                 b["is_v6"].any()
                 or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
             path_dict = None
-            if self._wire_l7:
-                self._l7_path_words = max(self._l7_path_words,
-                                          _path_words_of(b["http_path"]))
+            n_rows = int(b["valid"].shape[0])
+            zero_copy = self.config.zero_copy_ingest
+            # the lock covers only widen-then-choose + the pool checkout
+            # (a concurrent place() reset can only land before or after
+            # this batch's whole format decision, never between); the
+            # column writes themselves run outside it — they touch only
+            # the private wire_buf, and serializing them would double
+            # pack latency whenever a control-plane classify (health
+            # probe, CLI) overlaps the pipeline worker. L7 widening is
+            # POLICY-gated: with zero L7 rule sets, tokens cannot affect
+            # any verdict — shipping them is pure wire waste, and
+            # skipping them keeps tokenized traffic under an L7-free
+            # policy on the compact wire permanently (no reset/re-widen
+            # retrace flap across regens).
+            with self._pack_lock:
+                if snap.l7.n_sets > 0:
+                    self._wire_l7 |= batch_l7
+                self._wire_wide |= batch_wide
+                self._batches_since_wide = 0 if batch_wide \
+                    else self._batches_since_wide + 1
+                use_l7, use_wide = self._wire_l7, self._wire_wide
+                if use_l7:
+                    self._l7_path_words = max(self._l7_path_words,
+                                              _path_words_of(b["http_path"]))
+                    l7_path_words = self._l7_path_words
+                    l7_min_rows = self._l7_dict_rows
+                    words = (PACK_L7DICT_WORDS if use_wide
+                             else PACK4_L7_WORDS)
+                elif not use_wide:
+                    words = PACK4_WORDS
+                else:
+                    words = PACK_WORDS
+                wire_buf = self._wire_buf(n_rows, words) if zero_copy \
+                    else None
+                wire_key = (n_rows, words) if wire_buf is not None else None
+                self.pack_stats["pack_inplace" if wire_buf is not None
+                                else "pack_fallback"] += 1
+            if use_l7:
                 wire, path_dict = pack_batch_l7dict(
-                    b, path_words=self._l7_path_words,
-                    min_rows=self._l7_dict_rows,
-                    force_full=self._wire_wide)
-                self._l7_dict_rows = max(self._l7_dict_rows,
-                                         path_dict.shape[0])
-            elif not self._wire_wide:
-                wire = pack_batch_v4(b)
+                    b, path_words=l7_path_words, min_rows=l7_min_rows,
+                    force_full=use_wide, out=wire_buf)
+                with self._pack_lock:       # dict geometry stays grow-only
+                    self._l7_dict_rows = max(self._l7_dict_rows,
+                                             path_dict.shape[0])
+            elif not use_wide:
+                wire = pack_batch_v4(b, out=wire_buf)
             else:
-                wire = pack_batch(b)
+                wire = pack_batch(b, l7=False, out=wire_buf)
         with tracer.span(trace_id, "datapath.transfer",
                          bytes=int(wire.nbytes)):
             # chaos point: a wedged/failed host→device link (hang mode is
             # what the pipeline watchdog drill stalls on)
             FAULTS.fire("datapath.transfer")
             if path_dict is not None:
-                dev_batch = (jnp.asarray(wire), jnp.asarray(path_dict))
+                dev_batch = (jnp.asarray(wire),
+                             self._upload_path_dict(path_dict))
             else:
                 dev_batch = jnp.asarray(wire)
             with self._ct_lock:
@@ -316,8 +431,61 @@ class JITDatapath(DatapathBackend):
                 out_np = {k: np.asarray(v) for k, v in out.items()}
                 counters_np = {k: np.asarray(v)
                                for k, v in counters.items()}
+            if wire_key is not None:
+                # the device is provably done with this batch (out_np is
+                # materialized): the wire buffer is safe to reuse now —
+                # and ONLY now (a dispatch that never finalizes simply
+                # sheds its buffer to the GC)
+                self._wire_buf_release(wire_key, wire_buf)
             return out_np, counters_np
         return finalize
+
+    def _wire_buf(self, rows: int, words: int) -> Optional[np.ndarray]:
+        """Checkout a pooled wire buffer (pack lock held); it returns to
+        the pool in its batch's finalize. Pool misses allocate (the pool
+        refills in steady state). Non-power-of-two row counts — rare
+        control-plane batches (health probes, pcap tails) — return None:
+        pooling every distinct size ever seen would grow without bound."""
+        if rows & (rows - 1):
+            return None
+        pool = self._wire_pool.get((rows, words))
+        if pool:
+            return pool.pop()
+        return np.empty((rows, words), dtype=np.uint32)
+
+    def _wire_buf_release(self, key: Tuple[int, int],
+                          buf: np.ndarray) -> None:
+        with self._pack_lock:
+            pool = self._wire_pool.setdefault(key, [])
+            if len(pool) < self._wire_pool_cap:
+                pool.append(buf)
+
+    def _upload_path_dict(self, path_dict: np.ndarray):
+        """Device copy of the L7 path dict, cached by content: serving
+        traffic repeats a small stable path set, so in steady state the
+        dict (host-compared — same cost as one pack column) is uploaded
+        once and every later batch reuses the device array."""
+        with self._pack_lock:
+            cached_host = self._path_dict_host
+            cached_dev = self._path_dict_dev
+        # compare + upload OUTSIDE the lock: array_equal is O(dict) and
+        # the upload is a host→device transfer — neither may serialize
+        # concurrent pack work (concurrent misses just race to fill the
+        # cache; last write wins, both uploads are correct)
+        if (cached_dev is not None and cached_host is not None
+                and cached_host.shape == path_dict.shape
+                and np.array_equal(cached_host, path_dict)):
+            with self._pack_lock:
+                self.pack_stats["upload_cache_hits"] += 1
+            return cached_dev
+        dev = self._jnp.asarray(path_dict)
+        with self._pack_lock:
+            self.pack_stats["upload_cache_misses"] += 1
+            # the dict is a fresh np.unique product (never pool-aliased):
+            # safe to retain as the comparison baseline without a copy
+            self._path_dict_host = path_dict
+            self._path_dict_dev = dev
+        return dev
 
     def _classify_async_sharded(self, placed, snap, batch, now):
         from cilium_tpu.parallel.mesh import steer_batch, unsteer_outputs
@@ -327,6 +495,9 @@ class JITDatapath(DatapathBackend):
         # live under the translated tuple) — same translation the shim runs
         lb = snap.lb if snap.lb.n_frontends else None
         with tracer.span(trace_id, "datapath.pack"):
+            # the steered multi-shard layout has no in-place variant yet
+            with self._pack_lock:
+                self.pack_stats["pack_fallback"] += 1
             steered, scatter, _per = steer_batch(
                 batch, self.n_flow_shards, lb=lb, round_to_pow2=True)
         with tracer.span(trace_id, "datapath.transfer"):

@@ -120,6 +120,11 @@ class Engine:
         self._mesh = None          # ClusterMesh when cluster_store set
         self._pipeline = None      # ingestion Pipeline, started on demand
         self._pipeline_stopped = False   # stop() bars lazy restart
+        self._feeder = None        # shim/feeder.py harvest thread
+        self._pack_stats_seen: Dict[str, int] = {}  # scrape-delta baseline
+        self._pack_fold_lock = threading.Lock()     # concurrent scrapes
+        self._remap_snap = None    # dispatch-time slot-LUT cache key
+        self._remap_lut: Optional[np.ndarray] = None
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -441,6 +446,28 @@ class Engine:
         fencing as classify — and defers metrics/flow-log to finalize, when
         the verdicts are actually on the host."""
         active = self.active
+        raw = batch.get("_ep_raw")
+        if raw is not None and raw.any():
+            # shim-fed rows carry their raw endpoint ids (raw != 0):
+            # re-map them onto THIS dispatch's snapshot — slots are
+            # re-enumerated on regen, so a harvest-time mapping can go
+            # stale in the queue and classify rows under another
+            # endpoint's policy. Unknown ids fail closed; rows without a
+            # raw id (non-shim producers coalesced into the same bucket)
+            # keep their submitted ep_slot untouched. Vectorized via the
+            # same per-snapshot LUT the feeder uses (cached; one worker
+            # thread calls this, no lock needed).
+            from cilium_tpu.shim.feeder import build_slot_lut, \
+                map_raw_slots
+            snap = active.snapshot
+            if snap is not self._remap_snap:
+                self._remap_lut = build_slot_lut(snap.ep_slot_of)
+                self._remap_snap = snap
+            slots = map_raw_slots(raw, snap.ep_slot_of, self._remap_lut)
+            has = raw != 0
+            good = has & (slots >= 0)
+            batch["ep_slot"][good] = slots[good]
+            batch["valid"] &= ~(has & (slots < 0))
         with self.metrics.span("pipeline_dispatch").timer():
             fin = self.datapath.classify_async(
                 active.tensors, active.snapshot, batch, now)
@@ -454,6 +481,39 @@ class Engine:
             self.flowmetrics.add_batch(batch, out, now)
             return out
         return finalize
+
+    # -- async shim ingestion (shim/feeder.py) ----------------------------------
+    def start_feeder(self, shim):
+        """Attach an async shim→pipeline feeder: a harvest thread polls
+        ``shim`` on a budget, submits harvested batches into the ingestion
+        pipeline (reusable poll buffers, no per-poll allocation), and
+        applies verdicts FIFO as tickets resolve — replacing the
+        synchronous poll→classify→apply loop. Knobs: ``ingest_*`` in
+        DaemonConfig. Stopped (drained) by :meth:`stop`."""
+        with self._lock:
+            if self._feeder is not None:
+                return self._feeder
+            from cilium_tpu.shim.feeder import ShimFeeder
+            cfg = self.config
+            if shim.batch_size > cfg.batch_size:
+                # fail fast: a harvest batch that can't fit the pipeline's
+                # largest bucket would reject EVERY submission — the feeder
+                # would run "healthy" while fail-closing 100% of traffic
+                raise ValueError(
+                    f"shim batch_size {shim.batch_size} exceeds the "
+                    f"pipeline's max bucket (batch_size={cfg.batch_size})")
+            self.start_pipeline()
+            self._feeder = ShimFeeder(
+                shim, self,
+                pool_batches=cfg.ingest_pool_batches,
+                poll_budget=cfg.ingest_poll_budget,
+                idle_sleep_s=cfg.ingest_idle_sleep_s,
+                metrics=self.metrics, tracer=self.tracer).start()
+            return self._feeder
+
+    def feeder_stats(self) -> Optional[Dict]:
+        fd = self._feeder
+        return fd.stats() if fd is not None else None
 
     def sweep(self, now: Optional[int] = None) -> int:
         """CT garbage collection (upstream ctmap GC)."""
@@ -638,6 +698,17 @@ class Engine:
         """The full Prometheus exposition: device/host metrics plus the
         flow-metrics totals (one text body for /v1/metrics and the
         textfile exporter)."""
+        # zero-copy ingestion attribution: fold the datapath's monotone
+        # pack/upload ints in as real counters (delta since last scrape —
+        # a *_total gauge would trip PromQL counter semantics)
+        pack = getattr(self.datapath, "pack_stats", None)
+        if pack:
+            with self._pack_fold_lock:   # API scrape vs textfile flush
+                for k, v in pack.items():
+                    d = v - self._pack_stats_seen.get(k, 0)
+                    if d:
+                        self.metrics.inc_counter(f"datapath_{k}_total", d)
+                        self._pack_stats_seen[k] = v
         return (self.metrics.render_prometheus()
                 + self.flowmetrics.render_prometheus())
 
@@ -658,6 +729,12 @@ class Engine:
             os.replace(tmp, self.config.metrics_path)
 
     def stop(self) -> None:
+        with self._lock:
+            fd, self._feeder = self._feeder, None
+        if fd is not None:
+            # feeder first: it drains the shim and applies remaining
+            # verdicts THROUGH the still-open pipeline
+            fd.stop()
         with self._lock:
             pl, self._pipeline = self._pipeline, None
             self._pipeline_stopped = True    # submit() must not resurrect it

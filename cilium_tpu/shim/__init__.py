@@ -1,4 +1,16 @@
-"""Native ingress shim + golden-vector generator (C++, ctypes-bound).
+"""Native ingress shim + golden-vector generator (C++, ctypes-bound) and
+the async shim→pipeline feeder (``shim/feeder.py``).
 
 Build with ``make -C cilium_tpu/shim`` (or ``make shim`` at repo root).
 """
+
+__all__ = ["ShimFeeder"]
+
+
+def __getattr__(name):
+    # lazy: importing the package must not require the built .so (feeder
+    # pulls in bindings, which raises without libflowshim.so)
+    if name == "ShimFeeder":
+        from cilium_tpu.shim.feeder import ShimFeeder
+        return ShimFeeder
+    raise AttributeError(name)

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from cilium_tpu.kernels.records import empty_batch
+from cilium_tpu.kernels.records import empty_batch, reset_batch_rows
 from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
 
@@ -135,6 +135,13 @@ class FlowShim:
         self.batch_size = batch_size
         self._rec_buf = (ShimRecord * batch_size)()
         self._tok_buf = (ShimTokens * batch_size)()
+        # structured views over the ctypes buffers, built once — frombuffer
+        # per poll would allocate a view object on the harvest hot path
+        self._rec_view = np.frombuffer(self._rec_buf, dtype=self._REC_DTYPE,
+                                       count=batch_size)
+        self._tok_view = np.frombuffer(self._tok_buf, dtype=self._TOK_DTYPE,
+                                       count=batch_size)
+        self._l7_pos = np.arange(C.L7_PATH_MAXLEN)
         # record counts of harvested-but-unverdicted batches, FIFO — the
         # C++ side holds one FrameRef per emitted record, so apply_verdicts
         # must consume exactly that many per batch (short verdict arrays
@@ -169,10 +176,28 @@ class FlowShim:
         ("has_tokens", "u1"), ("method", "u1"), ("path_len", "<u2"),
         ("path", "u1", (C.L7_PATH_MAXLEN,)), ("pad", "u1", (4,))])
 
-    def poll_batch(self, now_us: int = 0, force: bool = False
+    def make_poll_buffer(self) -> Dict[str, np.ndarray]:
+        """A reusable ``poll_batch(out=...)`` buffer: the records layout
+        plus the shim-side ``_ep_raw``/``_frame_idx`` columns. The feeder
+        preallocates a pool of these so the hot harvest loop never builds
+        a fresh column dict per poll."""
+        b = empty_batch(self.batch_size)
+        b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
+        b["_frame_idx"] = np.zeros((self.batch_size,), dtype=np.int64)
+        return b
+
+    def poll_batch(self, now_us: int = 0, force: bool = False,
+                   out: Optional[Dict[str, np.ndarray]] = None
                    ) -> Optional[Dict[str, np.ndarray]]:
         """Harvest a batch in the kernels/records layout (None if not ready).
-        Records for unknown endpoints (ep_id 0) stay invalid (fail closed)."""
+        Records for unknown endpoints (ep_id 0) stay invalid (fail closed).
+
+        ``out=`` reuses a caller-owned buffer from :meth:`make_poll_buffer`
+        instead of allocating: rows [:n] are overwritten, rows [n:] are
+        reset to the empty-batch defaults (``valid`` False, method ANY,
+        zeroed path) so a reused buffer is indistinguishable from a fresh
+        one. The caller must not hand the same buffer back before its
+        previous batch's consumer is done with it."""
         FAULTS.fire("shim.rx_ring")
         n = self._lib.shim_poll_batch(self._handle, now_us, int(force),
                                       self._rec_buf, self._tok_buf)
@@ -182,13 +207,9 @@ class FlowShim:
         if not self._enforcing \
                 and len(self._pending_counts) > MAX_UNVERDICTED_BATCHES:
             self._pending_counts.pop(0)   # C++ aged out the same batch
-        b = empty_batch(self.batch_size)
-        b["_ep_raw"] = np.zeros((self.batch_size,), dtype=np.int64)
-        b["_frame_idx"] = np.zeros((self.batch_size,), dtype=np.int64)
-        rec = np.frombuffer(self._rec_buf, dtype=self._REC_DTYPE,
-                            count=self.batch_size)
-        tok = np.frombuffer(self._tok_buf, dtype=self._TOK_DTYPE,
-                            count=self.batch_size)
+        b = out if out is not None else self.make_poll_buffer()
+        rec = self._rec_view
+        tok = self._tok_view
         b["src"][:n] = rec["src"][:n]
         b["dst"][:n] = rec["dst"][:n]
         b["sport"][:n] = rec["sport"][:n]
@@ -203,9 +224,19 @@ class FlowShim:
         has = tok["has_tokens"][:n].astype(bool)
         b["http_method"][:n] = np.where(has, tok["method"][:n],
                                         C.HTTP_METHOD_ANY)
-        pos = np.arange(C.L7_PATH_MAXLEN)
+        pos = self._l7_pos
         keep = has[:, None] & (pos[None, :] < tok["path_len"][:n, None])
         b["http_path"][:n] = np.where(keep, tok["path"][:n], 0)
+        if out is not None:
+            if n < self.batch_size:
+                # reused buffer: restore the empty-batch tail so stale
+                # rows from the previous poll can never leak into this
+                # batch — a reused buffer must be indistinguishable from
+                # a fresh one
+                reset_batch_rows(b, n, self.batch_size)
+            # ep_slot is caller-mapped (poll never writes it): restore the
+            # fresh-buffer zeros across ALL rows, not just the tail
+            b["ep_slot"][:] = 0
         return b
 
     def apply_verdicts(self, allow: np.ndarray) -> None:

@@ -1,0 +1,381 @@
+"""Async shim→pipeline feeder: the harvest half of zero-copy ingestion.
+
+Before this module the shim path was synchronous per poll: poll a batch,
+classify it with a blocking wait, apply verdicts, repeat — the device idles
+during every harvest and the host idles during every classify. The feeder
+replaces that loop with a harvest thread that
+
+- polls the :class:`~cilium_tpu.shim.bindings.FlowShim` on a budget
+  (AF_XDP rings and the heap-mocked rings drain through ``afxdp_poll``;
+  the plain mock batcher through ``poll_batch`` alone),
+- writes harvested columns straight into a small pool of reusable poll
+  buffers (``FlowShim.make_poll_buffer`` — no per-poll column dict),
+- maps shim endpoint ids onto the active snapshot's slots (vectorized,
+  lookup table cached per snapshot; unknown endpoints fail closed),
+- submits each buffer to the engine's ingestion pipeline and
+- applies verdicts **FIFO** as tickets resolve — the C++ shim holds one
+  FrameRef per emitted record, so verdict order must equal harvest order;
+  a rejected/shed/timed-out ticket is applied as all-drop (fail closed)
+  rather than skipped, which would desync frames from verdicts.
+
+Buffer lifecycle: a poll buffer stays owned by the pipeline from submit
+until its ticket resolves (the scheduler stages from it asynchronously),
+so the pool bounds feeder in-flight batches; when every buffer is busy the
+feeder blocks on the oldest ticket — natural backpressure from the device
+straight back to the rx ring (frames simply wait in the ring).
+
+Fault tolerance: the ``shim.rx_ring`` injection point fires inside both
+poll entry points; a trip is one failed poll — frames stay queued and
+drain on the next poll. ``stop()`` drains: remaining rx frames are
+force-harvested, submitted, and every pending verdict applied in order.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from cilium_tpu.observe.trace import TRACER, Tracer
+from cilium_tpu.runtime.faults import FaultInjected
+from cilium_tpu.runtime.metrics import Metrics
+from cilium_tpu.shim.bindings import MAX_UNVERDICTED_BATCHES, FlowShim
+
+log = logging.getLogger("cilium_tpu.feeder")
+
+#: dense-LUT cap: one sparse/huge ep_id must not turn the per-snapshot
+#: LUT rebuild into a multi-GB allocation — fall back to dict lookups
+DENSE_LUT_MAX = 1 << 20
+
+
+def build_slot_lut(slot_of: Dict[int, int],
+                   dense_max: int = DENSE_LUT_MAX
+                   ) -> Optional[np.ndarray]:
+    """ep_id → slot lookup array for one snapshot (None when the id space
+    is too sparse to densify — callers fall back to dict lookups)."""
+    size = max(slot_of, default=0) + 1
+    if size > dense_max:
+        return None
+    lut = np.full((size,), -1, dtype=np.int32)
+    for ep_id, slot in slot_of.items():
+        if 0 <= ep_id < size:
+            lut[ep_id] = slot
+    return lut
+
+
+def map_raw_slots(raw: np.ndarray, slot_of: Dict[int, int],
+                  lut: Optional[np.ndarray]) -> np.ndarray:
+    """[N] raw shim ep ids → [N] snapshot slots; -1 for unknown ids AND
+    for raw == 0 ("no id"). Vectorized through the dense LUT when one
+    exists, per-row dict lookups otherwise. Shared by the feeder's
+    harvest-time mapping and the engine's dispatch-time re-mapping so the
+    fail-closed semantics cannot diverge."""
+    if lut is not None:
+        slots = lut[np.clip(raw, 0, lut.size - 1)]
+        # out-of-range ids INCLUDING negatives fail closed (a negative
+        # would otherwise wrap-index the LUT and steal another
+        # endpoint's slot); 0 means "no id"
+        return np.where((raw >= lut.size) | (raw <= 0),
+                        np.int32(-1), slots)
+    return np.fromiter(
+        (slot_of.get(int(e), -1) if e else -1 for e in raw),
+        dtype=np.int32, count=raw.shape[0])
+
+
+class ShimFeeder:
+    """Harvest thread feeding one ``FlowShim`` into one engine's pipeline.
+
+    ``engine`` needs ``submit(batch, now=...) -> Ticket`` and
+    ``active.snapshot`` (slot mapping) — the real Engine, or any
+    duck-typed stand-in in tests."""
+
+    def __init__(self, shim: FlowShim, engine, *,
+                 pool_batches: int = 4,
+                 poll_budget: int = 256,
+                 idle_sleep_s: float = 0.0005,
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 name: str = "feeder"):
+        if not 1 <= pool_batches <= MAX_UNVERDICTED_BATCHES:
+            raise ValueError(
+                f"pool_batches must be in [1, {MAX_UNVERDICTED_BATCHES}] "
+                "(the shim ages out unverdicted batches past that)")
+        if poll_budget < 1:
+            raise ValueError("poll_budget must be >= 1")
+        self.shim = shim
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.tracer = tracer if tracer is not None else TRACER
+        self._poll_budget = poll_budget
+        self._idle_sleep_s = idle_sleep_s
+        self._name = name
+
+        self._free: deque = deque(shim.make_poll_buffer()
+                                  for _ in range(pool_batches))
+        self._pending: deque = deque()     # (ticket, buf) in harvest order
+        self._zeros = np.zeros((shim.batch_size,), dtype=bool)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rings: Optional[bool] = None  # afxdp/mock rings attached?
+        self._snap = None                   # slot-lookup cache key
+        self._slot_lut = np.full((1,), -1, dtype=np.int32)
+
+        # stats (single-writer: the feeder thread; read via stats())
+        self.harvested_batches = 0
+        self.harvested_records = 0
+        self.applied_batches = 0
+        self.rejected_batches = 0          # applied fail-closed
+        self.harvest_faults = 0
+        self.errors = 0                    # unexpected step failures
+        self._submit_rejects = 0           # log-throttle counter
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ShimFeeder":
+        if self._thread is not None:
+            return self
+        self._stop.clear()      # restart after a clean stop() must harvest
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{self._name}-harvest")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop harvesting and drain: force-poll what the batcher still
+        holds, then apply every pending verdict FIFO (fail closed on
+        tickets that cannot resolve within ``timeout``)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                # keep the thread ref: nulling it would let a later
+                # start() spawn a SECOND harvester interleaving
+                # poll/apply on the same shim — frame/verdict desync
+                log.warning("feeder thread did not stop within %.1fs; "
+                            "keeping it registered", timeout)
+                return
+            self._thread = None
+
+    def stats(self) -> Dict:
+        t = self._thread
+        return {
+            "harvested_batches": self.harvested_batches,
+            "harvested_records": self.harvested_records,
+            "applied_batches": self.applied_batches,
+            "rejected_batches": self.rejected_batches,
+            "harvest_faults": self.harvest_faults,
+            "errors": self.errors,
+            "alive": bool(t is not None and t.is_alive()),
+            "pending": len(self._pending),
+            "pool_free": len(self._free),
+        }
+
+    # -- harvest loop ---------------------------------------------------------
+    def _run(self) -> None:
+        # supervised degradation: a failing step (e.g. engine.active
+        # raising through a regen failure storm) must not kill ingestion
+        # for the daemon's lifetime — count, log (throttled), retry
+        while not self._stop.is_set():
+            try:
+                progressed = self._step(force=False)
+            except Exception:   # noqa: BLE001 — keep harvesting
+                progressed = False
+                self._count_error("harvest step failed")
+            if not progressed and not self._stop.is_set():
+                if self._idle_sleep_s:
+                    self._stop.wait(self._idle_sleep_s)
+        try:
+            self._drain()
+        except Exception:   # noqa: BLE001
+            log.exception("feeder drain failed")
+
+    def _count_error(self, what: str) -> None:
+        self.errors += 1
+        self.metrics.inc_counter("feeder_errors_total")
+        if self.errors <= 3 or self.errors % 100 == 0:
+            log.exception("feeder %s (error %d); retrying", what,
+                          self.errors)
+
+    def _step(self, force: bool) -> bool:
+        """One harvest iteration. Returns True when any work happened."""
+        progressed = self._apply_ready(block=False)
+        buf = self._acquire_buffer()
+        if buf is None:
+            return progressed            # pool exhausted and head not done
+        now_us = int(time.monotonic() * 1e6)
+        tid = self.tracer.maybe_sample()
+        try:
+            with self.tracer.span(tid, "shim.harvest", force=force):
+                if self._rings_attached():
+                    rc = self.shim.afxdp_poll(self._poll_budget,
+                                              now_us=now_us)
+                    if rc < 0:
+                        log.debug("afxdp_poll -> %d", rc)
+                b = self.shim.poll_batch(now_us=now_us, force=force,
+                                         out=buf)
+        except FaultInjected:
+            # one failed poll: frames stay queued in the ring and drain on
+            # the next poll — the supervised-degradation contract
+            self.harvest_faults += 1
+            self.metrics.inc_counter("feeder_harvest_faults_total")
+            self._free.append(buf)
+            return progressed
+        except Exception:   # noqa: BLE001 — buffer must return to the pool
+            self._free.append(buf)
+            self._count_error("poll failed")
+            return progressed
+        if b is None:
+            self._free.append(buf)
+            return progressed
+        self.harvested_batches += 1
+        self.metrics.inc_counter("feeder_harvest_batches_total")
+        ticket = None
+        try:
+            n_valid = self._map_slots(b)
+            self.harvested_records += n_valid
+            self.metrics.inc_counter("feeder_harvest_records_total",
+                                     n_valid)
+            ticket = self.engine.submit(b)
+        except Exception as e:   # noqa: BLE001 — unavailable/closed/
+            # regen-storm engine.active/... : the shim already holds this
+            # batch's FrameRefs, so a verdict MUST be consumed for it —
+            # but strictly AFTER the batches harvested before it
+            # (apply_verdicts always consumes the OLDEST batch). The
+            # rejection rides the pending queue as a ``None`` sentinel and
+            # is applied all-drop in FIFO position, never out of order.
+            self._submit_rejects += 1
+            if self._submit_rejects <= 3 or self._submit_rejects % 100 == 0:
+                # throttled: a breaker-open storm rejects at harvest rate
+                log.warning("feeder submit rejected (%d), queueing "
+                            "fail-closed drop verdicts: %s",
+                            self._submit_rejects, e)
+        self._pending.append((ticket, buf))
+        self.metrics.set_gauge("feeder_pending", len(self._pending))
+        return True
+
+    def _acquire_buffer(self):
+        if self._free:
+            return self._free.popleft()
+        # pool exhausted: backpressure — block on the OLDEST ticket (FIFO),
+        # bounded so stop() stays responsive
+        if self._apply_ready(block=True, block_timeout=0.05):
+            if self._free:
+                return self._free.popleft()
+        return None
+
+    def _rings_attached(self) -> bool:
+        """Whether rx/fill rings exist (AF_XDP bind or mocked); without
+        them the plain feed_frame→batcher path needs no ring drain. Only a
+        positive probe is cached: a transient zero fill level (every umem
+        descriptor in flight, or rings initialized after start) must not
+        permanently disable the ring drain."""
+        if self._rings:
+            return True
+        self._rings = self.shim.ring_fill_level() > 0
+        return bool(self._rings)
+
+    #: class-level alias (tests monkeypatch it to force the sparse path)
+    DENSE_LUT_MAX = DENSE_LUT_MAX
+
+    # -- slot mapping ---------------------------------------------------------
+    def _map_slots(self, b: Dict[str, np.ndarray]) -> int:
+        """Shim-ep-id → snapshot-slot mapping, in place (build_slot_lut /
+        map_raw_slots — shared with the engine's dispatch-time re-map).
+        Records for endpoints the snapshot doesn't know go invalid (fail
+        closed). Returns the surviving valid count."""
+        snap = self.engine.active.snapshot
+        if snap is not self._snap:
+            self._slot_lut = build_slot_lut(snap.ep_slot_of,
+                                            self.DENSE_LUT_MAX)
+            self._snap = snap
+        slots = map_raw_slots(b["_ep_raw"], snap.ep_slot_of,
+                              self._slot_lut)
+        unknown = slots < 0
+        b["ep_slot"][:] = np.where(unknown, 0, slots)
+        b["valid"] &= ~unknown
+        return int(b["valid"].sum())
+
+    # -- verdict application (FIFO) -------------------------------------------
+    def _apply_ready(self, block: bool,
+                     block_timeout: float = 0.0) -> bool:
+        """Apply verdicts for resolved head tickets, strictly FIFO. With
+        ``block`` the head ticket is awaited up to ``block_timeout``."""
+        did = False
+        while self._pending:
+            ticket, buf = self._pending[0]
+            if ticket is not None and not ticket.done():
+                if not block:
+                    break
+                try:
+                    ticket.result(timeout=block_timeout)
+                except TimeoutError:
+                    break
+                except Exception:   # noqa: BLE001 — applied below
+                    pass
+                block = False        # at most one blocking wait per call
+            self._pending.popleft()
+            self._apply_one(ticket, buf)
+            did = True
+        self.metrics.set_gauge("feeder_pending", len(self._pending))
+        return did
+
+    def _apply_one(self, ticket, buf, recycle: bool = True) -> None:
+        """Apply one batch's verdicts (``ticket is None``: the rejected-
+        at-submit sentinel — all-drop, fail closed). ``recycle=False``
+        sheds the buffer instead of pooling it — for tickets that did NOT
+        resolve: the pipeline may still stage from the buffer later."""
+        rejected = True
+        allow = self._zeros
+        if ticket is not None:
+            try:
+                out = ticket.result(timeout=0)
+                allow = out["allow"]
+                rejected = False
+            except Exception:   # noqa: BLE001 — drop/shed/unavailable
+                pass
+        try:
+            self.shim.apply_verdicts(allow)
+        except Exception:   # noqa: BLE001
+            log.exception("apply_verdicts failed; frame/verdict FIFO may "
+                          "be desynced")
+        if rejected:
+            self.rejected_batches += 1
+            self.metrics.inc_counter("feeder_rejected_batches_total")
+        self.applied_batches += 1
+        self.metrics.inc_counter("feeder_applied_batches_total")
+        if recycle:
+            self._free.append(buf)
+
+    def _drain(self) -> None:
+        """Stop-path drain: alternate force-harvesting what the batcher
+        still holds with resolving + applying pending verdicts FIFO, until
+        neither makes progress — a busy device can exhaust the pool
+        mid-drain, so harvesting must resume after the pending sweep frees
+        buffers (bounded: the producer has stopped injecting)."""
+        for _round in range(2 * MAX_UNVERDICTED_BATCHES):
+            harvested = False
+            for _ in range(MAX_UNVERDICTED_BATCHES):
+                if not self._step(force=True):
+                    break
+                harvested = True
+            if not self._pending and not harvested:
+                break
+            while self._pending:
+                ticket, buf = self._pending.popleft()
+                resolved = True
+                if ticket is not None:
+                    try:
+                        ticket.result(timeout=10.0)
+                    except TimeoutError:
+                        # still owned by the (possibly wedged) pipeline:
+                        # apply fail-closed for FIFO, but NEVER pool the
+                        # buffer — a later stage could read it rewritten
+                        resolved = False
+                    except Exception:   # noqa: BLE001 — fail-closed below
+                        pass
+                self._apply_one(ticket, buf, recycle=resolved)
+        self.metrics.set_gauge("feeder_pending", len(self._pending))

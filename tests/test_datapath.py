@@ -172,3 +172,144 @@ print("JAXFREE_OK")
                               cwd="/root/repo")
         assert proc.returncode == 0, proc.stderr
         assert "JAXFREE_OK" in proc.stdout
+
+
+class TestWireFlagReset:
+    """Satellite pin: the sticky _wire_l7/_wire_wide widening flags reset
+    in place() when the NEW snapshot provably has no L7/v6 surface, so a
+    transient L7/v6 burst doesn't permanently tax every future batch with
+    the wide pack path — while verdicts stay correct throughout."""
+
+    L7_POLICY = [{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "ingress": [{"toPorts": [{
+            "ports": [{"port": "80", "protocol": "TCP"}],
+            "rules": {"http": [{"method": "GET", "path": "/api"}]}}]}],
+        "egress": [{"toCIDR": ["10.0.0.0/8"]}],
+    }]
+    PLAIN_POLICY = [{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [{"toCIDR": ["10.0.0.0/8"]}],
+    }]
+
+    def _l7_batch(self, eng):
+        from cilium_tpu.kernels.records import batch_from_records
+        recs = [pkt("192.168.1.30", "192.168.1.10", 50000 + i, 80,
+                    direction=C.DIR_INGRESS) for i in range(4)]
+        b = batch_from_records(recs, eng.active.snapshot.ep_slot_of)
+        b["http_method"][:] = 0
+        b["http_path"][:, :4] = np.frombuffer(b"/api", np.uint8)
+        return b
+
+    def _v4_batch(self, eng):
+        from cilium_tpu.kernels.records import batch_from_records
+        recs = [pkt("192.168.1.10", "10.1.2.3", 51000 + i, 443)
+                for i in range(4)]
+        return batch_from_records(recs, eng.active.snapshot.ep_slot_of)
+
+    def test_l7_burst_unsticks_after_l7_free_snapshot(self):
+        cfg = DaemonConfig(ct_capacity=2048, auto_regen=False,
+                           device="cpu", batch_size=32)
+        eng = Engine(cfg, datapath=JITDatapath(cfg))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.add_endpoint(["k8s:role=fe"], ips=("192.168.1.30",), ep_id=3)
+        eng.apply_policy(self.L7_POLICY)
+        eng.regenerate()
+        out = eng.classify(self._l7_batch(eng), now=100)
+        assert bool(out["allow"].all())
+        assert eng.datapath._wire_l7          # the burst widened the wire
+
+        # drop every L7 rule: the new snapshot has no L7 surface
+        eng.repo.clear()
+        eng.apply_policy(self.PLAIN_POLICY)
+        eng.regenerate(force=True)
+        assert not eng.datapath._wire_l7      # place() reset the flag
+        assert eng.datapath.pack_stats["wire_flag_resets"] >= 1
+        # subsequent traffic rides the compact wire AND verdicts stay
+        # correct (allowed CIDR flow)
+        out = eng.classify(self._v4_batch(eng), now=200)
+        assert bool(out["allow"].all())
+        assert not eng.datapath._wire_l7
+        eng.stop()
+
+    def test_v6_burst_unsticks_after_clean_run(self):
+        from cilium_tpu.runtime.datapath import WIRE_RESET_CLEAN_BATCHES
+        cfg = DaemonConfig(ct_capacity=2048, auto_regen=False,
+                           device="cpu", batch_size=32)
+        eng = Engine(cfg, datapath=JITDatapath(cfg))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(self.PLAIN_POLICY)
+        eng.regenerate()
+        b = self._v4_batch(eng)
+        b["is_v6"][0] = True                  # one stray v6 record
+        eng.classify(b, now=100)
+        assert eng.datapath._wire_wide
+        # a regen right after the burst must NOT narrow (hysteresis: with
+        # recent wide traffic a reset would retrace on the next v6 batch)
+        eng.regenerate(force=True)
+        assert eng.datapath._wire_wide
+        # after a clean run of v4-only batches the next regen narrows
+        for i in range(WIRE_RESET_CLEAN_BATCHES):
+            eng.classify(self._v4_batch(eng), now=110 + i)
+        eng.regenerate(force=True)
+        assert not eng.datapath._wire_wide
+        assert eng.datapath.pack_stats["wire_flag_resets"] >= 1
+        out = eng.classify(self._v4_batch(eng), now=300)
+        assert bool(out["allow"].all())
+        eng.stop()
+
+    def test_stale_staging_tail_does_not_pin_wide(self):
+        """A reused staging slot must not leak an earlier flush's v6 rows
+        into later batches' wire-format probes: after one coalesced v6
+        batch, subsequent v4-only coalesced batches through the SAME slot
+        must advance the clean-batch counter (else the wide wire could
+        never narrow on the serving path)."""
+        cfg = DaemonConfig(ct_capacity=2048, auto_regen=False,
+                           device="cpu", batch_size=64,
+                           pipeline_flush_ms=1.0)
+        eng = Engine(cfg, datapath=JITDatapath(cfg))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.apply_policy(self.PLAIN_POLICY)
+        eng.regenerate()
+        from cilium_tpu.kernels.records import batch_from_records
+        recs = [pkt("192.168.1.10", "10.1.2.3", 52000 + i, 443)
+                for i in range(40)]
+        big = batch_from_records(recs, eng.active.snapshot.ep_slot_of)
+        big["is_v6"][:] = False
+        big["is_v6"][5] = True                # one v6 row mid-batch
+        eng.submit(big, now=100)              # 40 rows: coalesced path
+        assert eng.drain(timeout=30)
+        assert eng.datapath._wire_wide
+        small = batch_from_records(recs[:8],
+                                   eng.active.snapshot.ep_slot_of)
+        for i in range(5):                    # 8 rows: same slots reused
+            eng.submit(dict(small), now=200 + i)
+            assert eng.drain(timeout=30)
+        assert eng.datapath._batches_since_wide >= 5, \
+            "stale staging tail re-tripped the wide probe"
+        eng.stop()
+
+    def test_tokens_without_l7_policy_never_widen(self):
+        """Policy-gated L7 widening: with zero L7 rule sets, http tokens
+        cannot affect verdicts — the wire stays compact under tokenized
+        traffic (no per-regen reset/re-widen retrace flap), and verdicts
+        still match the oracle, which does see the tokens."""
+        cfg = DaemonConfig(ct_capacity=2048, auto_regen=False,
+                           device="cpu", batch_size=32)
+        jit = Engine(cfg, datapath=JITDatapath(cfg))
+        fake = Engine(cfg, datapath=FakeDatapath(cfg))
+        for eng in (jit, fake):
+            eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",),
+                             ep_id=1)
+            eng.apply_policy(self.PLAIN_POLICY)
+            eng.regenerate()
+        b = self._v4_batch(jit)
+        b["http_method"][:] = 0               # shim tokenizes plain HTTP
+        b["http_path"][:, :4] = np.frombuffer(b"/idx", np.uint8)
+        out_j = jit.classify(dict(b), now=100)
+        out_f = fake.classify(dict(b), now=100)
+        for k in ("allow", "reason", "status", "remote_identity"):
+            np.testing.assert_array_equal(out_j[k], out_f[k])
+        assert not jit.datapath._wire_l7      # tokens never widened it
+        jit.stop()
+        fake.stop()

@@ -13,7 +13,11 @@ from cilium_tpu.kernels import conntrack as ctk
 from cilium_tpu.kernels.hashing import hash_words_jnp, hash_words_np
 from cilium_tpu.kernels.l7 import l7_match_batch
 from cilium_tpu.kernels.lpm import lpm_lookup_batch
-from cilium_tpu.kernels.records import ct_key_words, empty_batch
+from cilium_tpu.kernels.records import (PACK4_L7_WORDS, PACK4_WORDS,
+                                        PACK_L7DICT_WORDS, PACK_WORDS,
+                                        ct_key_words, empty_batch,
+                                        pack_batch, pack_batch_l7dict,
+                                        pack_batch_v4)
 from cilium_tpu.model.rules import HTTPRule
 from cilium_tpu.utils import constants as C
 from cilium_tpu.utils.ip import parse_addr
@@ -177,3 +181,95 @@ class TestCTKernel:
             jnp_words = np.asarray(ctk.ct_key_words_jnp(
                 {k: jnp.asarray(v) for k, v in b.items()}, reverse=rev))
             np.testing.assert_array_equal(np_words, jnp_words)
+
+
+class TestPackOutVariants:
+    """out= pack kernels must produce byte-identical wires to the
+    allocating versions across every format, including partially-filled
+    (valid-masked) buckets — the staging ring's correctness contract."""
+
+    @staticmethod
+    def _batch(n, n_valid=None, v6=False, l7=False, seed=0):
+        rng = np.random.default_rng(seed)
+        b = empty_batch(n)
+        b["src"][:, 2] = 0xFFFF
+        b["dst"][:, 2] = 0xFFFF
+        b["src"][:, 3] = rng.integers(0, 2**32, n, dtype=np.uint32)
+        b["dst"][:, 3] = rng.integers(0, 2**32, n, dtype=np.uint32)
+        b["sport"][:] = rng.integers(0, 65536, n)
+        b["dport"][:] = rng.integers(0, 65536, n)
+        b["proto"][:] = rng.choice([6, 17, 1], n)
+        b["tcp_flags"][:] = rng.integers(0, 256, n)
+        b["ep_slot"][:] = rng.integers(0, 8, n)
+        b["direction"][:] = rng.integers(0, 2, n)
+        b["valid"][: n if n_valid is None else n_valid] = True
+        if v6:
+            b["is_v6"][::3] = True
+            b["src"][::3, 0] = 0x20010DB8
+        if l7:
+            paths = [b"/api/v1", b"/submit", b"/", b"/static/app.js"]
+            for i in range(0, n, 2):
+                p = paths[i % len(paths)]
+                b["http_method"][i] = i % 3
+                b["http_path"][i, : len(p)] = np.frombuffer(p, np.uint8)
+        return b
+
+    def test_v4_out_bit_identical(self):
+        b = self._batch(32, n_valid=20)
+        want = pack_batch_v4(b)
+        out = np.full((32, PACK4_WORDS), 0xDEADBEEF, dtype=np.uint32)
+        got = pack_batch_v4(b, out=out)
+        np.testing.assert_array_equal(got, want)
+        assert got.base is out or got is out       # wrote in place
+
+    def test_v4_out_oversized_prefix(self):
+        """A max_bucket-rows ring buffer serves smaller buckets through
+        its [:n] prefix."""
+        b = self._batch(16)
+        out = np.zeros((64, PACK4_WORDS), dtype=np.uint32)
+        got = pack_batch_v4(b, out=out)
+        assert got.shape == (16, PACK4_WORDS)
+        np.testing.assert_array_equal(got, pack_batch_v4(b))
+        np.testing.assert_array_equal(out[:16], got)
+
+    def test_full_out_bit_identical(self):
+        for v6 in (False, True):
+            b = self._batch(24, n_valid=17, v6=v6, seed=3)
+            want = pack_batch(b)
+            got = pack_batch(b, out=np.empty((24, want.shape[1]),
+                                             np.uint32))
+            np.testing.assert_array_equal(got, want)
+
+    def test_full_out_l7_path_block(self):
+        b = self._batch(16, n_valid=9, l7=True, seed=4)
+        want = pack_batch(b)                       # auto-detects l7
+        assert want.shape[1] > PACK_WORDS
+        got = pack_batch(b, out=np.empty_like(want))
+        np.testing.assert_array_equal(got, want)
+
+    def test_l7dict_out_both_variants(self):
+        # compact 5-word variant
+        b = self._batch(16, n_valid=11, l7=True, seed=5)
+        w0, d0 = pack_batch_l7dict(b)
+        assert w0.shape[1] == PACK4_L7_WORDS
+        w1, d1 = pack_batch_l7dict(
+            b, out=np.empty((16, PACK4_L7_WORDS), np.uint32))
+        np.testing.assert_array_equal(w0, w1)
+        np.testing.assert_array_equal(d0, d1)
+        # full 12-word variant (force_full, as the wide sticky path does)
+        w0, d0 = pack_batch_l7dict(b, force_full=True)
+        assert w0.shape[1] == PACK_L7DICT_WORDS
+        w1, d1 = pack_batch_l7dict(
+            b, force_full=True,
+            out=np.empty((16, PACK_L7DICT_WORDS), np.uint32))
+        np.testing.assert_array_equal(w0, w1)
+        np.testing.assert_array_equal(d0, d1)
+
+    def test_out_mismatch_rejected(self):
+        b = self._batch(8)
+        with pytest.raises(ValueError):
+            pack_batch_v4(b, out=np.zeros((4, PACK4_WORDS), np.uint32))
+        with pytest.raises(ValueError):
+            pack_batch_v4(b, out=np.zeros((8, PACK_WORDS), np.uint32))
+        with pytest.raises(ValueError):
+            pack_batch_v4(b, out=np.zeros((8, PACK4_WORDS), np.int32))
