@@ -9,7 +9,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
-        ingest-smoke shim bench clean
+        ingest-smoke multichip-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -33,7 +33,19 @@ chaos-pipeline:
 # pytest, plus the slow-marked 10k-submission watchdog soak. A fast subset
 # on the fake datapath runs in tier-1 (tests/test_faults.py,
 # tests/test_pipeline_guard.py via chaos-pipeline).
-chaos: chaos-pipeline ingest-smoke
+# Multi-chip serving gate (parallel/mesh.py + the sharded staging ring):
+# the host-platform 8-device tier-1 subset — steering invariants, mesh
+# parity, the sharded-pipeline parity suite (1-shard vs 8-shard
+# bit-identical, steered staging mechanics, steer-overflow shed,
+# alloc-free steered staging) — plus the slow-marked 10k-submission
+# sharded soak with `shim.rx_ring` faults armed, which asserts
+# `datapath_pack_fallback_total{reason="steered"}` stays 0 (the steered
+# serving path packs in place into pooled per-shard wire segments).
+multichip-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_parallel.py tests/test_sharded_pipeline.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_sharded_pipeline.py -q -m slow
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

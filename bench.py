@@ -711,7 +711,7 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
 
 def pipeline_bench(config: int, preset: str, batch: int, batches: int,
                    windows: int = 3, verbose: bool = False,
-                   trace: bool = False):
+                   trace: bool = False, shards: int = 1):
     """Serial vs pipelined ingestion on one config, through the real
     ``DatapathBackend`` boundary (JITDatapath behind the Pipeline
     scheduler), over the same ingest stream: the shim's rx polls deliver
@@ -727,6 +727,13 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
       compute, one device shape, 8x fewer dispatches.
 
     Same flows, same CT geometry, same kernel — the delta is scheduling.
+
+    ``shards`` > 1 routes both modes through the flow-sharded mesh (one
+    admission queue, steered staging, per-shard wire segments): serial
+    classifies through the sync sharded path (steer at classify time),
+    pipelined through the pre-steered staging ring. Requires ``shards``
+    visible devices; tracing auto-enables so the artifact always carries
+    the steer/scatter span split.
     """
     from cilium_tpu.observe.trace import TRACER
     from cilium_tpu.pipeline import Pipeline
@@ -734,6 +741,8 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     from cilium_tpu.runtime.datapath import JITDatapath
     from cilium_tpu.runtime.metrics import Metrics
 
+    sharded = shards > 1
+    trace = trace or sharded
     if trace:
         # --trace: sample every submission so the per-stage summary in the
         # JSON artifact covers the whole run (admission/microbatch/dispatch/
@@ -746,7 +755,7 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     compile_s = time.time() - t0
     cfg = DaemonConfig(ct_capacity=snap.ct_config.capacity,
                        probe_depth=snap.ct_config.probe_depth,
-                       v4_only=v4_only, batch_size=batch)
+                       v4_only=v4_only, batch_size=batch, n_shards=shards)
     dp = JITDatapath(cfg)
     placed = dp.place(snap)
     rng = np.random.default_rng(7)
@@ -767,9 +776,18 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
             now[0] += 1
             dp.classify(placed, snap, chunks[i % len(chunks)], now[0])
 
+    lb = snap.lb if snap.lb.n_frontends else None
+
+    def shard_fn(b):
+        from cilium_tpu.parallel.mesh import flow_shard_of
+        return flow_shard_of(b, shards, lb=lb)
+
     def make_pipeline(met):
-        def dispatch_fn(b, n):
-            fin = dp.classify_async(placed, snap, b, n)
+        def dispatch_fn(b, n, steer_rev=None):
+            # fixed snapshot for the whole run: a pre-steered bucket can
+            # never be stale, whatever revision it was steered under
+            fin = dp.classify_async(placed, snap, b, n,
+                                    pre_steered=sharded)
             return lambda: fin()[0]
         # min_bucket == batch: every coalesced dispatch is the one
         # device-optimal shape (no trace proliferation)
@@ -781,16 +799,27 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
                         admission="block", block_timeout_s=60.0,
                         flush_ms=cfg.pipeline_flush_ms,
                         inflight=cfg.pipeline_inflight,
-                        stall_timeout_s=300.0)
+                        stall_timeout_s=300.0,
+                        n_shards=shards if sharded else 1,
+                        shard_fn=shard_fn if sharded else None,
+                        shard_headroom=cfg.pipeline_shard_headroom)
 
     met = Metrics()
     pl = make_pipeline(met)        # long-lived, like a serving daemon's
+    # pack attribution for the PIPELINED passes only — the serial
+    # comparison mode classifies through the sync path, whose allocating
+    # steer is counted "steered" by design and must not pollute the
+    # steered-staging acceptance numbers
+    pack_pipe = {k: 0 for k in dp.pack_stats}
 
     def pipe_pass():
+        base = dict(dp.pack_stats)
         for i in range(batches * (batch // chunk)):
             now[0] += 1
             pl.submit(chunks[i % len(chunks)], now=now[0])
         assert pl.drain(timeout=600), "pipeline drain timed out"
+        for k in pack_pipe:
+            pack_pipe[k] += dp.pack_stats[k] - base.get(k, 0)
 
     serial_pass()                   # calibrate both modes on a warm link
     pipe_pass()
@@ -821,7 +850,7 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
               f"{[round(x / 1e6, 1) for x in serial_tp]}\n"
               f"# pipelined windows (Mfl/s): "
               f"{[round(x / 1e6, 1) for x in pipe_tp]}", file=sys.stderr)
-    return {
+    doc = {
         "metric": f"pipeline_ingestion_{METRIC_NAMES[config]}",
         "value": round(pipe_med, 1),
         "unit": "flows/sec",
@@ -854,10 +883,127 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
         **({"trace_spans": TRACER.summary(),
             "trace_stats": TRACER.stats()} if trace else {}),
     }
+    if sharded:
+        doc.update({
+            "shards": shards,
+            "aggregate_flows_per_sec": round(pipe_med, 1),
+            "per_chip_flows_per_sec": round(pipe_med / shards, 1),
+            "vs_baseline": round(pipe_med / shards / PER_CHIP_TARGET, 4),
+            "pack_stats": pack_pipe,
+            "pack_stats_total": dict(dp.pack_stats),
+            "shard_fill": stats.get("shard_fill"),
+            "shard_rows_total": stats.get("shard_rows_total"),
+            "shard_capacity": stats.get("shard_capacity"),
+        })
+        spans = doc.get("trace_spans", {})
+        doc["steer_split"] = {k: spans[k] for k in
+                              ("pipeline.steer", "pipeline.stage_write",
+                               "datapath.pack", "datapath.steer")
+                              if k in spans}
+        doc.update(_sharded_schema_check(doc, shards))
+    return doc
+
+
+#: max tolerated per-shard traffic skew, expressed as a multiple of the
+#: fair share (1/shards of all rows) one shard may carry before the
+#: artifact is failed — a healthy flow hash over uniform traffic sits
+#: near 1x; one saturated shard means the mesh throughput number is a lie
+SHARD_SKEW_LIMIT = float(os.environ.get(
+    "CILIUM_TPU_BENCH_SHARD_SKEW_LIMIT", "3"))
+
+
+def _sharded_schema_check(doc: dict, shards: int) -> dict:
+    """Artifact self-check for sharded runs: the per-chip/aggregate fields
+    must be present, the steer/scatter attribution must be in the split,
+    the steered path must not have fallen back to allocating packs, and —
+    the real balance check — every flow shard must actually have carried
+    traffic within SHARD_SKEW_LIMIT of the mean (`shard_rows_total` is
+    counted independently at ingest, so a steering bug that parks the work
+    on one chip fails the artifact loudly instead of hiding inside an
+    aggregate headline)."""
+    problems = []
+    if doc.get("aggregate_flows_per_sec", 0) <= 0 \
+            or doc.get("per_chip_flows_per_sec", 0) <= 0:
+        problems.append("missing per-chip/aggregate throughput")
+    if "pipeline.steer" not in doc.get("steer_split", {}) \
+            and "pipeline.steer" not in doc.get("stage_split", {}):
+        problems.append("steer span missing from the stage split")
+    pack = doc.get("pack_stats") or {}
+    if pack.get("pack_fallback_steered", 0):
+        problems.append(
+            f'pack_fallback{{reason="steered"}} = '
+            f'{pack["pack_fallback_steered"]} on the steered path')
+    rows = doc.get("shard_rows_total")
+    if not rows or len(rows) != shards:
+        problems.append("shard_rows_total missing from pipeline stats")
+    elif sum(rows) >= 64 * shards:       # enough traffic to judge balance
+        total = sum(rows)
+        # judged as max SHARE of total vs the fair share 1/shards: the
+        # max-share threshold is capped at 0.95 so the check stays live
+        # for every mesh size (a max/mean formulation is mathematically
+        # dead whenever the limit reaches the shard count — a 2-shard
+        # mesh can never exceed 2x its mean)
+        share_limit = min(0.95, SHARD_SKEW_LIMIT / shards)
+        max_share = max(rows) / total
+        if min(rows) == 0:
+            problems.append(f"idle shard(s): shard_rows_total={rows}")
+        elif max_share > share_limit:
+            problems.append(
+                f"shard skew: one shard carries {max_share:.0%} of rows "
+                f"(> {share_limit:.0%} = {SHARD_SKEW_LIMIT}x fair share): "
+                f"shard_rows_total={rows}")
+    return {"schema_check": "ok" if not problems else "failed",
+            **({"schema_check_problems": problems} if problems else {})}
+
+
+#: BENCH_r05 reference points for the single-chip regression gate (the
+#: CPU smoke rig numbers the zero-copy PR shipped with); override via env
+#: when re-baselining on different hardware. NOISE_FACTOR is deliberately
+#: generous — the gate exists to catch the steered-staging refactor
+#: regressing the single-shard path wholesale, not 5% jitter.
+REF_PACK_P50_MS = float(os.environ.get(
+    "CILIUM_TPU_BENCH_REF_PACK_P50_MS", "0.116"))
+REF_INGEST_FPS = float(os.environ.get(
+    "CILIUM_TPU_BENCH_REF_INGEST_FPS", "0"))       # 0 = unknown, skip
+BENCH_NOISE_FACTOR = float(os.environ.get(
+    "CILIUM_TPU_BENCH_NOISE_FACTOR", "1.75"))
+
+
+def _single_chip_regression_gate(spans: dict, fps: float) -> dict:
+    """--shards 1 gate: the steered-staging refactor must not tax the
+    single-chip path — fail the artifact when pack p50 (or, with a known
+    reference, end-to-end fps) regresses beyond noise vs BENCH_r05."""
+    gate = {
+        "pack_p50_ms": spans.get("datapath.pack", {}).get("p50_ms"),
+        "ref_pack_p50_ms": REF_PACK_P50_MS,
+        "steer_p50_ms": spans.get("pipeline.steer", {}).get("p50_ms", 0.0),
+        "fps": round(fps, 1),
+        "ref_fps": REF_INGEST_FPS or None,
+        "noise_factor": BENCH_NOISE_FACTOR,
+        # the default reference is the BENCH_r05 CPU smoke rig: a `failed`
+        # verdict from a different-speed machine with no env-pinned
+        # baseline is a rig mismatch, not a regression — consumers can
+        # tell from this field
+        "ref_source": "env" if "CILIUM_TPU_BENCH_REF_PACK_P50_MS"
+                      in os.environ else "BENCH_r05-default",
+    }
+    reasons = []
+    p50 = gate["pack_p50_ms"]
+    if p50 is not None and REF_PACK_P50_MS > 0 \
+            and p50 > REF_PACK_P50_MS * BENCH_NOISE_FACTOR:
+        reasons.append(f"pack p50 {p50}ms > "
+                       f"{REF_PACK_P50_MS}*{BENCH_NOISE_FACTOR}ms")
+    if REF_INGEST_FPS > 0 and fps < REF_INGEST_FPS / BENCH_NOISE_FACTOR:
+        reasons.append(f"fps {fps:.0f} < "
+                       f"{REF_INGEST_FPS}/{BENCH_NOISE_FACTOR}")
+    gate["failed"] = bool(reasons)
+    if reasons:
+        gate["reasons"] = reasons
+    return gate
 
 
 def ingest_bench(preset: str, batch: int, n_frames: int = 0,
-                 verbose: bool = False):
+                 verbose: bool = False, shards: int = 1):
     """Shim→verdict end-to-end over the mock rings: frames are injected
     NIC-side into the rx ring, the async feeder (shim/feeder.py) harvests
     on a budget into reusable poll buffers, the pipeline coalesces and
@@ -884,7 +1030,8 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
     cfg = DaemonConfig(ct_capacity=1 << (14 if preset == "smoke" else 18),
                        auto_regen=False, batch_size=batch,
                        pipeline_flush_ms=1.0, pipeline_queue_batches=256,
-                       ingest_pool_batches=8, flowlog_mode="none")
+                       ingest_pool_batches=8, flowlog_mode="none",
+                       n_shards=shards)
     eng = Engine(cfg, datapath=JITDatapath(cfg))
     eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
     # a non-trivial ruleset so classification isn't a no-op: cfg1-style
@@ -962,9 +1109,10 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
     fstats = feeder.stats()
     pack_stats = dict(eng.datapath.pack_stats)
     spans = TRACER.summary()
-    keep = ("shim.harvest", "pipeline.stage_write", "pipeline.microbatch",
-            "pipeline.dispatch", "pipeline.finalize", "datapath.pack",
-            "datapath.transfer", "datapath.compute")
+    keep = ("shim.harvest", "pipeline.steer", "pipeline.stage_write",
+            "pipeline.microbatch", "pipeline.dispatch", "pipeline.finalize",
+            "datapath.pack", "datapath.steer", "datapath.transfer",
+            "datapath.compute")
     eng.stop()
     st = shim.stats()
     shim.close()
@@ -973,7 +1121,7 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
               f"elapsed={elapsed:.2f}s fps={fps / 1e6:.3f}M "
               f"passes={st['verdict_passes']} drops={st['verdict_drops']} "
               f"tx_full={st['tx_full_drops']}", file=sys.stderr)
-    return {
+    doc = {
         "metric": "ingest_shim_to_verdict",
         "value": round(fps, 1),
         "unit": "frames/sec",
@@ -996,9 +1144,28 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
         "staging_slots": pstats.get("staging_slots"),
         "fill_ratio": pstats.get("fill_ratio_avg"),
         "flush_reasons": pstats.get("flush_reasons"),
+        "shed_reasons": pstats.get("shed_reasons"),
         "pack_stats": pack_stats,
         "feeder": fstats,
     }
+    if shards > 1:
+        doc.update({
+            "shards": shards,
+            "aggregate_frames_per_sec": round(fps, 1),
+            "per_chip_frames_per_sec": round(fps / shards, 1),
+            "aggregate_flows_per_sec": round(fps, 1),
+            "per_chip_flows_per_sec": round(fps / shards, 1),
+            "shard_fill": pstats.get("shard_fill"),
+            "shard_rows_total": pstats.get("shard_rows_total"),
+            "shard_capacity": pstats.get("shard_capacity"),
+        })
+        doc.update(_sharded_schema_check(doc, shards))
+    else:
+        # satellite gate: the refactored (shard-capable) staging path must
+        # stay within noise of BENCH_r05 on the single-chip configuration
+        doc["regression_gate"] = _single_chip_regression_gate(
+            doc["stage_split"], fps)
+    return doc
 
 
 def main(argv=None):
@@ -1030,7 +1197,11 @@ def main(argv=None):
                          "10k smoke / 100k full)")
     ap.add_argument("--shards", type=int, default=1,
                     help="flow shards (data-parallel mesh axis); >1 routes "
-                         "through the production multi-chip path")
+                         "through the production multi-chip path — with "
+                         "--pipeline/--ingest: steered staging + per-shard "
+                         "wire segments behind one admission queue, "
+                         "reporting per-chip AND aggregate flows/s plus "
+                         "the steer/scatter span split")
     ap.add_argument("--rule-shards", type=int, default=1,
                     help="verdict-row shards (rule-space mesh axis)")
     ap.add_argument("--windows", type=int, default=5,
@@ -1043,13 +1214,32 @@ def main(argv=None):
 
     import os
 
-    import jax
     need = args.shards * args.rule_shards
     if need > 1 and not os.environ.get("CILIUM_TPU_BENCH_REAL_MESH"):
-        # a virtual CPU mesh on a 1-chip rig (the __graft_entry__ idiom;
-        # env vars alone lose to sitecustomize TPU-plugin registration).
-        # On a real multi-chip rig set CILIUM_TPU_BENCH_REAL_MESH=1 to use
-        # the live TPU devices instead.
+        # a virtual CPU mesh on a 1-chip rig. The env vars must land
+        # BEFORE the first jax import (jax < 0.5 has no
+        # jax_num_cpu_devices config; XLA_FLAGS is the only knob) — and
+        # the config.update below still runs as a belt-and-braces for
+        # images whose sitecustomize TPU-plugin registration imports jax
+        # first. On a real multi-chip rig set CILIUM_TPU_BENCH_REAL_MESH=1
+        # to use the live TPU devices instead.
+        import re
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={need}").strip()
+        elif int(m.group(1)) < need:
+            # an inherited flag (e.g. the Makefile's 8) smaller than the
+            # requested mesh would die later in make_mesh — raise it
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0),
+                f"--xla_force_host_platform_device_count={need}")
+    import jax
+    if need > 1 and not os.environ.get("CILIUM_TPU_BENCH_REAL_MESH"):
         try:
             jax.config.update("jax_platforms", "cpu")
             jax.config.update("jax_num_cpu_devices", need)
@@ -1067,14 +1257,15 @@ def main(argv=None):
     _start_watchdog(METRIC_NAMES[args.config])
     if args.ingest:
         result = ingest_bench(preset, batch, n_frames=args.frames,
-                              verbose=args.verbose)
+                              verbose=args.verbose, shards=args.shards)
         _progress["headline"] = result
         print(json.dumps(result))
         return
     if args.pipeline:
         result = pipeline_bench(args.config, preset, batch, batches,
                                 windows=max(3, args.windows - 2),
-                                verbose=args.verbose, trace=args.trace)
+                                verbose=args.verbose, trace=args.trace,
+                                shards=args.shards)
         _progress["headline"] = result
         print(json.dumps(result))
         return

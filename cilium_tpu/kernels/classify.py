@@ -195,22 +195,8 @@ def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
     (pack_batch_v4), otherwise the full/L7 layout."""
     def fn(tensors, ct, batch, now, world_index):
         if packed:
-            from cilium_tpu.kernels.records import (
-                PACK4_WORDS, PACKA_L7_WORDS, PACKA_WORDS, unpack_batch_jnp,
-                unpack_batch_addrdict_jnp, unpack_batch_l7dict_jnp,
-                unpack_batch_v4_jnp)
-            if isinstance(batch, (tuple, list)):
-                wire = batch[0]
-                if wire.shape[1] in (PACKA_WORDS, PACKA_L7_WORDS):
-                    # (wire, addr_dict[, path_dict]): address-dictionary wire
-                    batch = unpack_batch_addrdict_jnp(*batch)
-                else:
-                    # (wire, path_dict): the L7 path-dictionary wire
-                    batch = unpack_batch_l7dict_jnp(*batch)
-            elif batch.shape[1] == PACK4_WORDS:
-                batch = unpack_batch_v4_jnp(batch)
-            else:
-                batch = unpack_batch_jnp(batch)
+            from cilium_tpu.kernels.records import unpack_wire_jnp
+            batch = unpack_wire_jnp(batch)
         return classify_step(tensors, ct, batch, now, world_index,
                              probe_depth=probe_depth, v4_only=v4_only)
     return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())

@@ -471,6 +471,33 @@ def unpack_batch_v4_jnp(packed):
     }
 
 
+def wire_words_for(use_l7: bool, use_wide: bool) -> int:
+    """Wire width (uint32 words/record) the serving path ships for a given
+    sticky-format decision — one ladder shared by the single-chip and the
+    sharded pack paths so the format choice cannot diverge between them."""
+    if use_l7:
+        return PACK_L7DICT_WORDS if use_wide else PACK4_L7_WORDS
+    return PACK_WORDS if use_wide else PACK4_WORDS
+
+
+def unpack_wire_jnp(batch):
+    """Device-side unpack of ANY packed wire form → the standard batch
+    dict, dispatching on the wire's static width/pytree at trace time:
+    tuple → dictionary wires (address or L7-path), [N,4] → compact v4,
+    otherwise the full layout. Shared by the single-chip jit and the
+    per-shard body of the meshed classify."""
+    if isinstance(batch, (tuple, list)):
+        wire = batch[0]
+        if wire.shape[1] in (PACKA_WORDS, PACKA_L7_WORDS):
+            # (wire, addr_dict[, path_dict]): address-dictionary wire
+            return unpack_batch_addrdict_jnp(*batch)
+        # (wire, path_dict): the L7 path-dictionary wire
+        return unpack_batch_l7dict_jnp(*batch)
+    if batch.shape[1] == PACK4_WORDS:
+        return unpack_batch_v4_jnp(batch)
+    return unpack_batch_jnp(batch)
+
+
 def unpack_batch_jnp(packed):
     """Device-side unpack (inside jit) → the standard batch dict. The L7
     path block is reconstructed when present (static via array width)."""

@@ -63,9 +63,40 @@ def flow_shard_of(batch: BatchArrays, n_shards: int,
     return (h % np.uint32(n_shards)).astype(np.int32)
 
 
+def steer_rows(shard: np.ndarray, n_shards: int, seg_cap: int,
+               fills: Optional[np.ndarray] = None,
+               counts: Optional[np.ndarray] = None) -> np.ndarray:
+    """Destination row per packet for a segmented steered layout: packet i
+    (shard ``shard[i]``) lands at ``shard[i]*seg_cap + fill + rank`` where
+    ``rank`` preserves arrival order within the shard (stable sort) and
+    ``fills`` are the segments' current occupancies (all-zero when absent).
+    This is the scatter half of ``steer_batch``, shared with the pipeline's
+    staging ring so flush-time steering is the same placement the classic
+    steer produces. The caller checks capacity (``fills + counts`` must stay
+    within ``seg_cap``); ``counts`` passes an already-computed
+    ``bincount(shard, minlength=n_shards)`` so hot callers don't pay the
+    histogram twice."""
+    m = shard.shape[0]
+    order = np.argsort(shard, kind="stable")
+    sorted_s = shard[order]
+    if counts is None:
+        counts = np.bincount(shard, minlength=n_shards)
+    counts = counts.astype(np.int64)
+    starts = np.zeros(n_shards + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    rank = np.arange(m, dtype=np.int64) - starts[sorted_s]
+    base = sorted_s * seg_cap + rank
+    if fills is not None:
+        base += np.asarray(fills, dtype=np.int64)[sorted_s]
+    rows = np.empty(m, dtype=np.int64)
+    rows[order] = base
+    return rows
+
+
 def steer_batch(batch: BatchArrays, n_shards: int,
                 per_shard: Optional[int] = None, lb=None,
-                round_to_pow2: bool = False
+                round_to_pow2: bool = False,
+                out: Optional[BatchArrays] = None
                 ) -> Tuple[BatchArrays, np.ndarray, int]:
     """Regroup a batch so packets of shard s occupy rows
     [s*per_shard, (s+1)*per_shard) (invalid-padded).
@@ -74,10 +105,21 @@ def steer_batch(batch: BatchArrays, n_shards: int,
     ``scatter_index[i]`` is the steered row of original packet i — use it to
     gather per-packet outputs back into original order.
 
+    ``out=`` scatters into a caller-owned column dict (a reusable steered
+    buffer) instead of allocating; its rows must cover
+    ``n_shards * per_shard`` and every batch key must be present. Rows not
+    written are restored to the empty-batch defaults, so a reused buffer
+    cannot leak a previous batch's records into the valid mask or the
+    wire-format probes. (The pipeline's staging ring does NOT come through
+    here — it scatters incrementally at ingest via ``steer_rows``; this
+    variant serves whole-batch callers that want to reuse one steered
+    buffer across calls.)
+
     Fully vectorized (argsort regroup) — this is the host half of the
     production multi-chip path, so it must keep up with the device, not just
     the dryrun (round-4 finding: the per-packet Python loop capped steering
     at ~1e5 pps)."""
+    from cilium_tpu.kernels.records import reset_batch_rows
     n = batch["valid"].shape[0]
     shard = flow_shard_of(batch, n_shards, lb=lb)
     validm = np.asarray(batch["valid"], dtype=bool)
@@ -92,17 +134,19 @@ def steer_batch(batch: BatchArrays, n_shards: int,
             per_shard = 1 << (per_shard - 1).bit_length()
     elif counts.max() > per_shard:
         raise ValueError("per_shard too small for steering")
-    # stable sort groups packets by shard while preserving arrival order
-    order = np.argsort(s, kind="stable")
-    sorted_s = s[order]
-    starts = np.zeros(n_shards + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
-    rank = np.arange(len(vidx), dtype=np.int64) - starts[sorted_s]
-    rows = sorted_s * per_shard + rank
-    src = vidx[order]
-    out = {k: np.zeros((n_shards * per_shard,) + v.shape[1:], dtype=v.dtype)
-           for k, v in batch.items()}
-    out["http_method"][:] = 255
+    rows = steer_rows(s, n_shards, per_shard, counts=counts)
+    src = vidx
+    total = n_shards * per_shard
+    if out is None:
+        out = {k: np.zeros((total,) + v.shape[1:], dtype=v.dtype)
+               for k, v in batch.items()}
+        out["http_method"][:] = 255
+    else:
+        if out["valid"].shape[0] < total:
+            raise ValueError(
+                f"steer out= buffer has {out['valid'].shape[0]} rows, "
+                f"need {total}")
+        reset_batch_rows(out, 0, total)
     scatter = np.full((n,), -1, dtype=np.int64)
     scatter[src] = rows
     for k, v in batch.items():
@@ -232,6 +276,15 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
 
     Call with (tensors, ct, batch, now, world_index) where batch rows are
     steered (steer_batch) and verdict rows padded (pad_snapshot_tensors).
+
+    ``batch`` may be the column dict (tests, the zero-copy-disabled path)
+    OR a packed wire — a single [N, words] uint32 array or an
+    ``(wire, path_dict)`` L7-dict pair (kernels/records pack formats, the
+    same contiguous-buffer transfer the single-chip path ships). The wire
+    rows shard over 'flows' (each chip unpacks only its own segment, fused
+    into the classify pipeline); the path dict replicates. This is what
+    lets the sharded serving path pack in place into one pooled buffer
+    whose per-shard segments ARE the per-chip transfers.
     """
     import jax
     try:
@@ -277,26 +330,49 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                  "rnat_sport")}
     counters_spec = {"by_reason_dir": P(), "insert_fail": P()}
 
+    def local_fn_packed(tensors, ct, wire, now, world_index):
+        # device-side unpack of the local wire segment; the width dispatch
+        # happens at trace time exactly like make_classify_fn(packed=True)
+        from cilium_tpu.kernels.records import unpack_wire_jnp
+        return local_fn(tensors, ct, unpack_wire_jnp(wire), now, world_index)
+
     # The snapshot's tensor key-set varies (LB tensors are elided when no
     # frontend exists), and shard_map in_specs must mirror the exact pytree —
-    # so build + cache one shard_map'd jit per key-set. Everything except the
-    # verdict is replicated (LB state included: small, read-only, gathered
-    # per packet).
-    jits: Dict[frozenset, Any] = {}
+    # so build + cache one shard_map'd jit per (key-set, batch kind).
+    # Everything except the verdict is replicated (LB state included: small,
+    # read-only, gathered per packet).
+    jits: Dict[Any, Any] = {}
 
     def call(tensors, ct, batch, now, world_index):
-        keyset = frozenset(tensors)
-        fn = jits.get(keyset)
+        if isinstance(batch, dict):
+            kind = "dict"
+        elif isinstance(batch, (tuple, list)):
+            batch = tuple(batch)
+            kind = f"wire_dict{len(batch)}"
+        else:
+            kind = "wire"
+        key = (frozenset(tensors), kind)
+        fn = jits.get(key)
         if fn is None:
             tensors_spec = {k: (verdict_spec if k == "verdict" else P())
                             for k in tensors}
+            if kind == "dict":
+                bspec: Any = batch_spec
+                body = local_fn
+            else:
+                # wire rows shard over 'flows'; every trailing dictionary
+                # ((wire, path_dict) or (wire, addr_dict, path_dict))
+                # replicates — the spec mirrors the tuple arity
+                bspec = (P("flows"),) + (P(),) * (len(batch) - 1) \
+                    if kind.startswith("wire_dict") else P("flows")
+                body = local_fn_packed
             fn = jax.jit(shard_map(
-                local_fn, mesh=mesh,
-                in_specs=(tensors_spec, ct_spec, batch_spec, P(), P()),
+                body, mesh=mesh,
+                in_specs=(tensors_spec, ct_spec, bspec, P(), P()),
                 out_specs=(out_spec, ct_spec, counters_spec),
                 **{_check_kw: False},
             ), donate_argnums=(1,) if donate_ct else ())
-            jits[keyset] = fn
+            jits[key] = fn
         return fn(tensors, ct, batch, now, world_index)
 
     return call

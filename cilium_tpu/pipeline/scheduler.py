@@ -81,6 +81,7 @@ import numpy as np
 
 from cilium_tpu.kernels.records import empty_batch, reset_batch_rows
 from cilium_tpu.observe.trace import TRACER, Tracer
+from cilium_tpu.parallel.mesh import steer_rows
 from cilium_tpu.pipeline.guard import (PIPELINE_STATES, CircuitBreaker,
                                        PipelineClosed,
                                        PipelineDeadlineExceeded,
@@ -111,6 +112,25 @@ RESTART_BUDGET_WINDOW_S = 300.0
 #: stall timeout before the watchdog calls it a device stall, so a healthy
 #: daemon's warmup can never restart-loop into hard-fail
 COLD_DISPATCH_GRACE = 4
+
+#: pre-binned ``_shard`` column encoding (written by the shim feeder, read
+#: by the sharded staging ring): low bits carry shard+1 (0 = not binned),
+#: high bits the policy revision the bin was hashed under — a bin from a
+#: superseded revision is re-hashed at stage-write, because an LB-table
+#: change moves service flows' post-DNAT steer hash (the same
+#: harvest-vs-dispatch staleness class the dispatch-time ep-slot remap
+#: exists for)
+SHARD_BIN_SHIFT = 16
+SHARD_BIN_MASK = (1 << SHARD_BIN_SHIFT) - 1
+SHARD_BIN_REV_MASK = (1 << 31) - 1      # revision bits (int64 column)
+
+
+def shard_bin_encode(shard: np.ndarray, revision: int) -> np.ndarray:
+    """Producer-side ``_shard`` column encoding (int64): shard+1 in the
+    low bits, the binning policy revision above — one definition shared
+    with the feeder so writer and reader cannot drift."""
+    return (np.int64((revision & SHARD_BIN_REV_MASK) << SHARD_BIN_SHIFT)
+            | (shard.astype(np.int64) + 1))
 
 # canonical out columns (the DatapathBackend.classify contract) — used to
 # resolve all-invalid submissions without a device round trip
@@ -200,15 +220,20 @@ class _Sub:
 class _Slice:
     """A submission's rows inside one dispatched bucket. ``valid_idx`` is
     None for a direct (zero-copy) dispatch: the out arrays already have the
-    submission's row geometry."""
+    submission's row geometry. ``dst_rows`` (sharded staging only) lists
+    the bucket rows this submission's valid rows were steered into, in
+    submission order — gathering outputs through it at finalize IS the
+    un-steer that keeps per-ticket verdicts in FIFO row order; unsharded
+    staging packs rows contiguously from ``dst_start`` instead."""
 
-    __slots__ = ("ticket", "valid_idx", "dst_start")
+    __slots__ = ("ticket", "valid_idx", "dst_start", "dst_rows")
 
     def __init__(self, ticket: Ticket, valid_idx: Optional[np.ndarray],
-                 dst_start: int):
+                 dst_start: int, dst_rows: Optional[np.ndarray] = None):
         self.ticket = ticket
         self.valid_idx = valid_idx
         self.dst_start = dst_start
+        self.dst_rows = dst_rows
 
 
 class _Inflight:
@@ -222,21 +247,31 @@ class _Inflight:
 
 
 class _StageBuf:
-    """One staging-ring slot: a preallocated max_bucket-row column batch
-    plus cached per-bucket prefix views, so a steady-state flush allocates
-    nothing — neither columns nor the view dict handed to dispatch (the
-    view dict for each power-of-two bucket is built once per buffer and
-    reused; a buffer is never rewritten while its views are in flight,
-    which is exactly the ring's recycle discipline)."""
+    """One staging-ring slot: a preallocated column batch plus cached
+    per-bucket prefix views, so a steady-state flush allocates nothing —
+    neither columns nor the view dict handed to dispatch (the view dict
+    for each power-of-two bucket is built once per buffer and reused; a
+    buffer is never rewritten while its views are in flight, which is
+    exactly the ring's recycle discipline).
 
-    __slots__ = ("cols", "_views")
+    Sharded pipelines size the slot as ``n_shards`` per-shard segments
+    (``rows = n_shards * seg_cap``): ingest scatters each valid row
+    straight into its flow shard's segment, so the flushed view is already
+    the steered layout the mesh wants. ``dirty`` tracks each segment's
+    content high-water mark across reuses — flush restores empty-batch
+    defaults only on [fill, dirty), not the whole tail, so segment resets
+    stay proportional to actual traffic."""
 
-    def __init__(self, max_bucket: int):
-        self.cols = empty_batch(max_bucket)
+    __slots__ = ("cols", "dirty", "_views")
+
+    def __init__(self, rows: int, n_shards: int = 1):
+        self.cols = empty_batch(rows)
         # shim-fed submissions carry raw endpoint ids so the dispatch-time
         # slot re-mapping survives coalescing; rows from producers without
         # the column stage as 0 (= "no raw id", left untouched downstream)
-        self.cols["_ep_raw"] = np.zeros((max_bucket,), dtype=np.int64)
+        self.cols["_ep_raw"] = np.zeros((rows,), dtype=np.int64)
+        self.dirty: Optional[List[int]] = [0] * n_shards \
+            if n_shards > 1 else None
         self._views: Dict[int, Dict[str, np.ndarray]] = {}
 
     def view(self, bucket: int) -> Dict[str, np.ndarray]:
@@ -270,7 +305,11 @@ class Pipeline:
                  breaker_cooldown_s: float = 5.0,
                  stall_timeout_s: float = 30.0,
                  max_restarts: int = 3,
-                 restart_backoff_s: float = 0.2):
+                 restart_backoff_s: float = 0.2,
+                 n_shards: int = 1,
+                 shard_fn: Optional[Callable] = None,
+                 shard_headroom: int = 4,
+                 shard_rev_fn: Optional[Callable[[], int]] = None):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
@@ -285,7 +324,49 @@ class Pipeline:
         if max_restarts < 0 or restart_backoff_s <= 0:
             raise ValueError("max_restarts must be >= 0 and "
                              "restart_backoff_s > 0")
+        if n_shards < 1 or n_shards & (n_shards - 1):
+            raise ValueError("n_shards must be a power of two >= 1")
+        if shard_headroom < 1 or shard_headroom & (shard_headroom - 1):
+            raise ValueError("shard_headroom must be a power of two >= 1")
+        if n_shards > 1 and shard_fn is None:
+            raise ValueError("a sharded pipeline needs shard_fn "
+                             "(per-row flow-shard ids)")
         self._dispatch_fn = dispatch_fn
+        # sharded staging (the software-RSS half of the multi-chip path):
+        # each staging slot holds n_shards per-shard segments of seg_cap
+        # rows; ingest steers rows into their segment, flush dispatches the
+        # ONE steered shape [n_shards * seg_cap] every time (a single XLA
+        # trace per wire format — sharded serving trades padded transfer
+        # bytes for zero recompile storms, exactly like the bench's
+        # uniform per-shard sizing). seg_cap carries `shard_headroom`x the
+        # even-split share so hash skew doesn't force tiny aggregates; a
+        # submission more skewed than that is shed ("steer_overflow"),
+        # never a worker-killing error.
+        self._n_shards = n_shards
+        self._shard_fn = shard_fn
+        self._shard_rev_fn = shard_rev_fn
+        if n_shards > 1:
+            self._seg_cap = min(max_bucket, _next_pow2(
+                max(1, max_bucket // n_shards) * shard_headroom))
+            self._stage_rows = n_shards * self._seg_cap
+        else:
+            self._seg_cap = 0
+            self._stage_rows = max_bucket
+        self._shard_fill: List[int] = [0] * n_shards
+        # lifetime per-shard ingest totals: the steering-balance surface
+        # (bench schema checks + operators read skew from here)
+        self._shard_rows_total: List[int] = [0] * n_shards
+        # the policy revision the staged bucket was steered under (-2 =
+        # riders steered under different revisions): rides into
+        # dispatch_fn so the engine can detect a regen landing between
+        # stage-write and dispatch and have the datapath RE-steer under
+        # the snapshot it actually classifies with — an LB change moves
+        # service flows' post-DNAT hash, and dispatching a stale steer
+        # would strand their CT entries on the wrong shard
+        self._stage_steer_rev: Optional[int] = None
+        self._shard_gauge_names = [
+            f'pipeline_staged_rows{{shard="{s}"}}'
+            for s in range(n_shards)] if n_shards > 1 else []
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else TRACER
         self._max_bucket = max_bucket
@@ -321,7 +402,7 @@ class Pipeline:
         self._hb: Optional[Tuple[float, str, int, int]] = None
 
         # worker-owned (no lock): staging ring + inflight window
-        self._buffers = [_StageBuf(max_bucket)
+        self._buffers = [_StageBuf(self._stage_rows, n_shards)
                          for _ in range(inflight + 1)]
         self._free_bufs: List[int] = list(range(len(self._buffers)))
         self._stage_buf: Optional[int] = None
@@ -353,6 +434,17 @@ class Pipeline:
         self._bucket_rows = 0
         self._pub: Dict = {}             # worker-published stats snapshot
 
+        if n_shards > 1:
+            # the guard runs per-mesh: one breaker/watchdog generation
+            # fences ALL shards together (a wedged shard must never yield
+            # half-mesh verdicts), and the gauge says how many chips one
+            # restart takes down
+            self.metrics.set_gauge("pipeline_mesh_shards", n_shards)
+            self._hb_dispatch_label = f"dispatch[mesh={n_shards}]"
+            self._hb_finalize_label = f"finalize[mesh={n_shards}]"
+        else:
+            self._hb_dispatch_label = "dispatch"
+            self._hb_finalize_label = "finalize"
         self.breaker = CircuitBreaker(
             breaker_threshold, breaker_cooldown_s, metrics=self.metrics,
             tracer=self.tracer, name=name,
@@ -609,6 +701,13 @@ class Pipeline:
             "submitted": submitted,
             "outstanding": outstanding,
             "queue_depth": queue_depth,
+            "n_shards": self._n_shards,
+            **({"shard_capacity": self._seg_cap,
+                "shard_fill": pub.get("shard_fill",
+                                      [0] * self._n_shards),
+                "shard_rows_total": pub.get("shard_rows_total",
+                                            [0] * self._n_shards)}
+               if self._n_shards > 1 else {}),
             "staged_rows": pub.get("staged_rows", 0),
             "inflight": pub.get("inflight", 0),
             "staging_free": pub.get("staging_free",
@@ -731,14 +830,17 @@ class Pipeline:
             self.metrics.set_gauge("pipeline_queue_depth", 0)
         # fresh staging ring: the old buffers may still be referenced by
         # the fenced-off worker — never reuse them
-        self._buffers = [_StageBuf(self._max_bucket)
+        self._buffers = [_StageBuf(self._stage_rows, self._n_shards)
                          for _ in range(self._inflight_max + 1)]
         self._free_bufs = list(range(len(self._buffers)))
+        self._shard_fill = [0] * self._n_shards
         # the gauge is otherwise only touched in acquire/recycle: without
         # this it would report the wedged worker's last value (usually 0)
         # through the whole recovery window
         self.metrics.set_gauge("pipeline_staging_free",
                                len(self._free_bufs))
+        for name in self._shard_gauge_names:
+            self.metrics.set_gauge(name, 0)   # fresh ring: empty segments
         self._stage_buf = None
         self._staged_rows = 0
         self._staged_slices = []
@@ -861,9 +963,13 @@ class Pipeline:
             return
         self._restart_worker(gen, "worker crashed")
 
-    def _shed(self, ticket: Ticket, reason: str) -> None:
-        """Deadline shed: the answer nobody is waiting for is not
-        computed. Counted per shed point in ``pipeline_shed_total``."""
+    def _shed(self, ticket: Ticket, reason: str,
+              exc: Optional[BaseException] = None) -> None:
+        """Shed one submission without computing it (deadline passed, or a
+        steer-overflow batch no shard segment can hold). Counted per shed
+        point in ``pipeline_shed_total``; default rejection is the deadline
+        error, ``exc`` overrides (steer overflow rejects with
+        :class:`PipelineDrop` — overload shed, retryable)."""
         with self._lock:
             self.shed_total += 1
             self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
@@ -873,10 +979,12 @@ class Pipeline:
                            ticket.submitted_mono,
                            time.monotonic() - ticket.submitted_mono,
                            {"reason": reason})
-        self._settle([(ticket, None, PipelineDeadlineExceeded(
-            f"deadline exceeded before {reason} (seq={ticket.seq}, "
-            f"waited {(time.monotonic() - ticket.submitted_mono) * 1e3:.1f}"
-            "ms)"))])
+        if exc is None:
+            exc = PipelineDeadlineExceeded(
+                f"deadline exceeded before {reason} (seq={ticket.seq}, "
+                f"waited "
+                f"{(time.monotonic() - ticket.submitted_mono) * 1e3:.1f}ms)")
+        self._settle([(ticket, None, exc)])
 
     # -- worker side ----------------------------------------------------------
     def _run(self, gen: int) -> None:
@@ -951,6 +1059,12 @@ class Pipeline:
                                t.submitted_mono, wait)
             self._settle([(t, _zero_out(t.n_rows), None)])
             return
+        if self._n_shards > 1:
+            # sharded staging: every row must land in its flow shard's
+            # segment, so even bucket-shaped submissions stage (no direct
+            # bypass — an arbitrary row order carries no shard placement)
+            self._ingest_sharded(sub, gen)
+            return
         rows = t.n_rows
         if (self._staged_rows == 0
                 and self._min_bucket <= rows <= self._max_bucket
@@ -1000,6 +1114,106 @@ class Pipeline:
         if self._staged_rows >= self._max_bucket:
             self._flush("full", gen)
 
+    def _shards_for(self, batch: Dict[str, np.ndarray],
+                    valid_idx: np.ndarray, rev: int) -> np.ndarray:
+        """Flow-shard id per valid row. A producer that already hashed
+        (the shim feeder's harvest pre-binning — the SHARD_BIN encoding:
+        low bits shard+1, 0 = not binned; high bits the policy revision
+        the bin was hashed under) skips the hash entirely — but ONLY when
+        the bin's revision matches ``rev``, the revision the caller read
+        BEFORE steering and will stamp the bucket with: a regen between
+        harvest and stage-write can change the LB tables and with them a
+        service flow's post-DNAT steer hash, and a stale bin would strand
+        its CT entry on the wrong shard. (Reading the revision once,
+        up-front, also means a regen landing DURING this call can at worst
+        stamp the bucket with the older revision — forcing a dispatch-time
+        re-steer — never accept stale rows under a fresh stamp.) Anything
+        else goes through ``shard_fn`` (the engine's direction-normalized
+        flow hash over the active snapshot's LB tables)."""
+        col = batch.get("_shard")
+        if col is not None:
+            raw = np.asarray(col)[valid_idx].astype(np.int64)
+            pre = (raw & SHARD_BIN_MASK) - 1
+            if pre.size and pre.min() >= 0 \
+                    and pre.max() < self._n_shards \
+                    and (self._shard_rev_fn is None
+                         or bool((raw >> SHARD_BIN_SHIFT
+                                  == (rev & SHARD_BIN_REV_MASK)).all())):
+                return pre
+        shard = np.asarray(self._shard_fn(batch), dtype=np.int64)
+        return shard[valid_idx]
+
+    def _ingest_sharded(self, sub: _Sub, gen: int) -> None:
+        """Steered staging (the software-RSS half of the multi-chip path):
+        each valid row is scattered directly into its flow shard's column
+        segment, so flush hands the datapath an already-steered batch and
+        the per-batch steer→allocate→pack chain never runs. Placement is
+        ``steer_rows`` — byte-identical to what ``steer_batch`` would
+        produce for the same arrival order, which is what makes 8-shard
+        pipeline verdicts bit-identical to the single-chip path."""
+        t = sub.ticket
+        m = t.n_valid
+        valid_idx = np.nonzero(np.asarray(sub.batch["valid"]))[0]
+        # the bucket's steer-revision stamp is read BEFORE hashing: a
+        # regen landing mid-steer then stamps the bucket with the OLDER
+        # revision (dispatch re-steers), never blesses stale rows
+        rev = self._shard_rev_fn() if self._shard_rev_fn is not None else 0
+        with self.tracer.span(t.trace_id, "pipeline.steer", rows=m):
+            shard = self._shards_for(sub.batch, valid_idx, rev)
+            counts = np.bincount(shard, minlength=self._n_shards)
+        if int(counts.max()) > self._seg_cap:
+            # one pathologically skewed submission can never fit a shard
+            # segment: shed with an attributable reason instead of letting
+            # the old per_shard ValueError crash the worker into a
+            # watchdog restart
+            self._shed(t, "steer_overflow", PipelineDrop(
+                f"steer overflow: {int(counts.max())} rows for one flow "
+                f"shard exceed the per-shard segment capacity "
+                f"{self._seg_cap} (seq={t.seq})"))
+            return
+        if self._staged_slices and bool(
+                (np.asarray(self._shard_fill) + counts
+                 > self._seg_cap).any()):
+            self._flush("full", gen)
+        if self._stage_buf is None:
+            self._stage_buf = self._acquire_buffer(gen)
+            self._stage_deadline = t.submitted_mono + self._flush_s
+            self._stage_now = None
+            self._stage_steer_rev = rev
+        elif self._stage_steer_rev != rev:
+            self._stage_steer_rev = -2       # mixed: dispatch must re-steer
+        stage = self._buffers[self._stage_buf]
+        buf = stage.cols
+        fills = self._shard_fill
+        with self.tracer.span(t.trace_id, "pipeline.microbatch", rows=m):
+            with self.tracer.span(t.trace_id, "pipeline.stage_write",
+                                  rows=m, slot=self._stage_buf):
+                dst_rows = steer_rows(shard, self._n_shards, self._seg_cap,
+                                      fills, counts=counts)
+                for k, col in buf.items():
+                    if k.startswith("_"):
+                        src = sub.batch.get(k)
+                        if src is None:
+                            col[dst_rows] = 0
+                            continue
+                    else:
+                        src = sub.batch[k]
+                    col[dst_rows] = np.asarray(src)[valid_idx]
+        for s in range(self._n_shards):
+            c = int(counts[s])
+            if c:
+                fills[s] += c
+                self._shard_rows_total[s] += c
+                stage.dirty[s] = max(stage.dirty[s], fills[s])
+        if self._stage_now is None:
+            self._stage_now = sub.now
+        self._staged_slices.append(_Slice(t, valid_idx, 0,
+                                          dst_rows=dst_rows))
+        self._staged_rows += m
+        self._publish(gen)
+        if max(fills) >= self._seg_cap:
+            self._flush("full", gen)
+
     def _flush(self, reason: str, gen: int) -> None:
         if not self._staged_slices:
             return
@@ -1009,6 +1223,12 @@ class Pipeline:
         rows = self._staged_rows
         slices = self._staged_slices
         now = self._stage_now
+        sharded = self._n_shards > 1
+        steer_rev = self._stage_steer_rev
+        self._stage_steer_rev = None
+        if sharded:
+            fills = self._shard_fill
+            self._shard_fill = [0] * self._n_shards
         # hand-off ordering: into _dispatching BEFORE leaving the staged
         # registry, so a concurrent sweep always sees every ticket
         self._dispatching = slices
@@ -1024,8 +1244,11 @@ class Pipeline:
         for sl in slices:
             dl = sl.ticket.deadline_mono
             if dl is not None and now_mono > dl:
-                n = len(sl.valid_idx)
-                buf["valid"][sl.dst_start:sl.dst_start + n] = False
+                if sl.dst_rows is not None:
+                    buf["valid"][sl.dst_rows] = False
+                else:
+                    n = len(sl.valid_idx)
+                    buf["valid"][sl.dst_start:sl.dst_start + n] = False
                 self._shed(sl.ticket, "flush")
             else:
                 live.append(sl)
@@ -1035,20 +1258,37 @@ class Pipeline:
             self._publish(gen)
             return
         n_valid = sum(len(sl.valid_idx) for sl in live)
-        bucket = max(self._min_bucket, _next_pow2(rows))
-        if rows < bucket:
-            # reused buffer: restore the empty-batch defaults on the tail,
-            # not just the valid mask — stale v6/L7/_ep_raw content from an
-            # earlier, larger flush would otherwise poison the datapath's
-            # wire-format probes (sticking the wide wire forever) and trip
-            # the strict v6 check in the compact pack kernel
-            reset_batch_rows(buf, rows, bucket)
+        if sharded:
+            # restore empty-batch defaults on each segment's stale tail
+            # (rows a previous, fuller use of this buffer wrote past the
+            # current fill) — same wire-format-probe poisoning guard as
+            # the unsharded tail reset, segment by segment. The dispatch
+            # shape is always the full steered layout: one trace per wire
+            # format, padded tails are valid-masked.
+            for s in range(self._n_shards):
+                base = s * self._seg_cap
+                if fills[s] < stage.dirty[s]:
+                    reset_batch_rows(buf, base + fills[s],
+                                     base + stage.dirty[s])
+                    stage.dirty[s] = fills[s]
+            bucket = self._stage_rows
+        else:
+            bucket = max(self._min_bucket, _next_pow2(rows))
+            if rows < bucket:
+                # reused buffer: restore the empty-batch defaults on the
+                # tail, not just the valid mask — stale v6/L7/_ep_raw
+                # content from an earlier, larger flush would otherwise
+                # poison the datapath's wire-format probes (sticking the
+                # wide wire forever) and trip the strict v6 check in the
+                # compact pack kernel
+                reset_batch_rows(buf, rows, bucket)
         self._dispatch(stage.view(bucket), now, live, bucket, n_valid,
-                       reason, buf_idx, gen)
+                       reason, buf_idx, gen, steer_rev=steer_rev)
 
     def _dispatch(self, batch: Dict[str, np.ndarray], now: Optional[int],
                   slices: List[_Slice], bucket_rows: int, n_valid: int,
-                  reason: str, buf_idx: Optional[int], gen: int) -> None:
+                  reason: str, buf_idx: Optional[int], gen: int,
+                  steer_rev: Optional[int] = None) -> None:
         # hand-off ordering invariant: these slices are in _dispatching
         # from before they leave any upstream registry until after they
         # are settled or appended to _inflight — a concurrent sweep can
@@ -1086,7 +1326,7 @@ class Pipeline:
         attempts = 0
         while True:
             try:
-                self._hb_arm("dispatch", gen,
+                self._hb_arm(self._hb_dispatch_label, gen,
                              grace=COLD_DISPATCH_GRACE
                              if self._cold_dispatch else 1)
                 FAULTS.fire("pipeline.dispatch")
@@ -1098,7 +1338,14 @@ class Pipeline:
                         self.tracer.span(tid, "pipeline.dispatch",
                                          bucket=bucket_rows,
                                          n_valid=n_valid, reason=reason):
-                    finalize = self._dispatch_fn(batch, now)
+                    if self._n_shards > 1:
+                        # sharded dispatch_fns take the steer revision so
+                        # the backend can detect a regen landing between
+                        # stage-write and here and re-steer under the
+                        # snapshot it classifies with
+                        finalize = self._dispatch_fn(batch, now, steer_rev)
+                    else:
+                        finalize = self._dispatch_fn(batch, now)
                 self._hb_clear(gen)
                 self._check_gen(gen)
                 break
@@ -1163,7 +1410,7 @@ class Pipeline:
         tid = next((sl.ticket.trace_id for sl in inf.slices
                     if sl.ticket.trace_id is not None), None)
         try:
-            self._hb_arm("finalize", gen)
+            self._hb_arm(self._hb_finalize_label, gen)
             FAULTS.fire("pipeline.finalize")
             self._check_gen(gen)     # hang-released fence: do not finalize
             with self.tracer.context(tid), \
@@ -1196,7 +1443,13 @@ class Pipeline:
                 if k not in tout:
                     tout[k] = np.zeros((sl.ticket.n_rows,) + arr.shape[1:],
                                        dtype=arr.dtype)
-                tout[k][sl.valid_idx] = arr[sl.dst_start:sl.dst_start + n]
+                # steered buckets: gathering through dst_rows un-steers
+                # this ticket's verdicts back into submission row order
+                if sl.dst_rows is not None:
+                    tout[k][sl.valid_idx] = arr[sl.dst_rows]
+                else:
+                    tout[k][sl.valid_idx] = arr[sl.dst_start:
+                                                sl.dst_start + n]
             outcomes.append((sl.ticket, tout, None))
         self.completed_batches += 1
         self._recycle(inf.buf_idx)
@@ -1221,9 +1474,22 @@ class Pipeline:
             "dispatched_batches": self.dispatched_batches,
             "completed_batches": self.completed_batches,
         }
+        if self._n_shards > 1:
+            snapshot["shard_fill"] = list(self._shard_fill)
+            snapshot["shard_rows_total"] = list(self._shard_rows_total)
         with self._lock:
-            if gen == self._gen:         # a fenced worker must not publish
-                self._pub = snapshot
+            if gen != self._gen:         # a fenced worker must not publish
+                return
+            self._pub = snapshot
+            # shard-labeled staging occupancy: which segment is the
+            # skew/backpressure hotspot (the per-mesh guard surface).
+            # Inside the gen-checked lock so a fenced worker can never
+            # overwrite the restart sweep's gauge reset with stale fills
+            # (metrics locks are leaves — same nesting as the sweep's own
+            # gauge writes); names precomputed, once per ingest.
+            for name, f in zip(self._shard_gauge_names,
+                               snapshot.get("shard_fill", ())):
+                self.metrics.set_gauge(name, f)
 
     def _acquire_buffer(self, gen: int) -> int:
         while not self._free_bufs:

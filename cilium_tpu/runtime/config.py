@@ -56,6 +56,10 @@ class DaemonConfig:
     pipeline_flush_ms: float = 2.0      # microbatch coalesce deadline
     pipeline_min_bucket: int = 256      # smallest dispatch shape (pow2)
     pipeline_inflight: int = 2          # overlapped batches in flight
+    # sharded staging (n_shards > 1): per-shard segment capacity =
+    # pow2(batch_size / n_shards) * headroom — slack for flow-hash skew
+    # before a submission sheds with reason="steer_overflow" (pow2)
+    pipeline_shard_headroom: int = 4
     # --- pipeline guard (pipeline/guard.py): overload + self-healing ---
     pipeline_deadline_ms: float = 0.0   # per-submission deadline (0 = none)
     pipeline_request_timeout_s: float = 10.0  # REST/CLI Ticket.result bound
@@ -110,6 +114,11 @@ class DaemonConfig:
         if self.pipeline_inflight < 1 or self.pipeline_queue_batches < 1:
             raise ValueError(
                 "pipeline_inflight and pipeline_queue_batches must be >= 1")
+        if (self.pipeline_shard_headroom < 1
+                or self.pipeline_shard_headroom
+                & (self.pipeline_shard_headroom - 1)):
+            raise ValueError(
+                "pipeline_shard_headroom must be a power of two >= 1")
         if self.ingest_pool_batches < 1 or self.ingest_poll_budget < 1:
             raise ValueError(
                 "ingest_pool_batches and ingest_poll_budget must be >= 1")

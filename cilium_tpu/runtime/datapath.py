@@ -114,8 +114,18 @@ class DatapathBackend(abc.ABC):
         remote_identity; counters has by_reason_dir [C.COUNTER_CELLS]
         (reasons x directions) + insert_fail."""
 
+    @property
+    def pipeline_shards(self) -> int:
+        """Flow-shard count the ingestion pipeline should steer for: > 1
+        means the backend serves a flow-sharded mesh and wants pipeline
+        batches delivered pre-steered (rows grouped into equal per-shard
+        segments along dim 0). 1 = no steering (the default; FakeDatapath
+        and single-chip JIT)."""
+        return 1
+
     def classify_async(self, placed: Any, snap: PolicySnapshot,
-                       batch: Dict[str, np.ndarray], now: int):
+                       batch: Dict[str, np.ndarray], now: int,
+                       pre_steered: bool = False):
         """Enqueue one batch and return a zero-argument *finalize* callable
         that blocks until the verdicts are ready and returns the same
         (out, counters) tuple ``classify`` would.
@@ -126,7 +136,14 @@ class DatapathBackend(abc.ABC):
         still computing this one. The default runs the backend's synchronous
         ``classify`` eagerly (FakeDatapath: a plain queue — no device, no
         overlap to win); the JIT backend overrides it with real async
-        dispatch."""
+        dispatch.
+
+        ``pre_steered``: the caller already grouped rows into
+        ``pipeline_shards`` equal segments by flow-shard (the sharded
+        staging ring); outputs come back in the same steered row geometry —
+        the caller owns un-steering. Meaningless (and ignored) on backends
+        with ``pipeline_shards == 1``, where row order carries no placement
+        semantics."""
         res = self.classify(placed, snap, batch, now)
         return lambda: res
 
@@ -182,6 +199,9 @@ class JITDatapath(DatapathBackend):
             self._mesh = make_mesh(self.n_flow_shards, self.n_rule_shards)
             self._ct_sharding = NamedSharding(self._mesh, P("flows"))
             self._repl_sharding = NamedSharding(self._mesh, P())
+            # packed wire rows shard over 'flows': each chip receives only
+            # its own segment of the pooled wire buffer
+            self._batch_sharding = NamedSharding(self._mesh, P("flows"))
             self._verdict_sharding = NamedSharding(
                 self._mesh, P(None, None, "rules", None))
             shard_ct_arrays(ct_host, self.n_flow_shards)
@@ -237,14 +257,30 @@ class JITDatapath(DatapathBackend):
         # batch after batch — an unchanged dict is never re-transferred
         self._path_dict_host: Optional[np.ndarray] = None
         self._path_dict_dev = None
-        # attribution counters (Engine surfaces them as gauges)
+        # attribution counters (Engine renders them as labeled Prometheus
+        # counters). The fallback split is the answer to "why did this
+        # batch allocate": ``disabled`` = zero_copy_ingest off, ``steered``
+        # = a sharded batch arrived un-steered (the sync/control-plane
+        # entry, which steers with an allocating regroup), ``shape`` = a
+        # non-power-of-two row count the pool refuses to hold. The serving
+        # path — pipelined, pre-steered, pow2 buckets — must show only
+        # ``pack_inplace``; the sharded soak asserts ``steered`` stays 0.
         self.pack_stats: Dict[str, int] = {
-            "pack_inplace": 0,       # packed into a staging-ring buffer
-            "pack_fallback": 0,      # allocated (sharded path, or disabled)
-            "upload_cache_hits": 0,  # path dict served from device cache
+            "pack_inplace": 0,            # packed into a pooled wire buffer
+            "pack_fallback_disabled": 0,  # zero-copy ingest turned off
+            "pack_fallback_steered": 0,   # un-steered sharded batch
+            "pack_fallback_shape": 0,     # unpoolable (non-pow2) row count
+            "upload_cache_hits": 0,       # path dict served from device cache
             "upload_cache_misses": 0,
-            "wire_flag_resets": 0,   # place() narrowed the wire format
+            "wire_flag_resets": 0,        # place() narrowed the wire format
         }
+
+    @property
+    def pipeline_shards(self) -> int:
+        """The ingestion pipeline steers for the flow axis only — rule
+        shards replicate the batch, so a rules-only mesh needs no row
+        grouping at all."""
+        return self.n_flow_shards if self._sharded else 1
 
     def _maybe_reset_wire_flags(self, snap: PolicySnapshot) -> None:
         """Un-stick the widened wire formats when the NEW snapshot provably
@@ -327,7 +363,92 @@ class JITDatapath(DatapathBackend):
     def classify(self, placed, snap, batch, now):
         return self.classify_async(placed, snap, batch, now)()
 
-    def classify_async(self, placed, snap, batch, now):
+    #: the exact key-set the sharded dict dispatch ships — shard_map
+    #: in_specs mirror this pytree, so staging-ring extras (``_ep_raw``)
+    #: must be filtered out before the call
+    _BATCH_KEYS = ("src", "dst", "sport", "dport", "proto", "tcp_flags",
+                   "is_v6", "ep_slot", "direction", "http_method",
+                   "http_path", "valid")
+
+    def _pack_wire(self, b, snap, pooled: bool, fallback_reason: str):
+        """The shared zero-copy pack: widen-then-choose the sticky wire
+        format under the pack lock, check out a pooled wire buffer, pack in
+        place. Returns (wire, path_dict_or_None, wire_key, wire_buf) —
+        wire_key is None when the pack allocated (the buffer then just
+        sheds to the GC instead of returning to the pool).
+
+        The lock covers only widen-then-choose + the pool checkout (a
+        concurrent place() reset can only land before or after this batch's
+        whole format decision, never between); the column writes themselves
+        run outside it — they touch only the private wire_buf, and
+        serializing them would double pack latency whenever a control-plane
+        classify (health probe, CLI) overlaps the pipeline worker. L7
+        widening is POLICY-gated: with zero L7 rule sets, tokens cannot
+        affect any verdict — shipping them is pure wire waste, and skipping
+        them keeps tokenized traffic under an L7-free policy on the compact
+        wire permanently (no reset/re-widen retrace flap across regens).
+
+        ``pooled=False`` skips the pool entirely and counts the batch under
+        ``pack_fallback_{fallback_reason}`` — for paths whose zero-copy
+        chain already broke upstream (the allocating steer of an un-steered
+        sharded batch)."""
+        from cilium_tpu.kernels.records import (
+            PACK4_EP_SLOT_MAX, _path_words_of, pack_batch, pack_batch_l7dict,
+            pack_batch_v4, wire_words_for)
+        batch_l7 = bool(
+            (b["http_method"] != C.HTTP_METHOD_ANY).any()
+            or b["http_path"].any())
+        batch_wide = bool(
+            b["is_v6"].any()
+            or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
+        path_dict = None
+        n_rows = int(b["valid"].shape[0])
+        zero_copy = self.config.zero_copy_ingest and pooled
+        with self._pack_lock:
+            if snap.l7.n_sets > 0:
+                self._wire_l7 |= batch_l7
+            self._wire_wide |= batch_wide
+            self._batches_since_wide = 0 if batch_wide \
+                else self._batches_since_wide + 1
+            use_l7, use_wide = self._wire_l7, self._wire_wide
+            if use_l7:
+                self._l7_path_words = max(self._l7_path_words,
+                                          _path_words_of(b["http_path"]))
+                l7_path_words = self._l7_path_words
+                l7_min_rows = self._l7_dict_rows
+            words = wire_words_for(use_l7, use_wide)
+            wire_buf = self._wire_buf(n_rows, words) if zero_copy else None
+            wire_key = (n_rows, words) if wire_buf is not None else None
+            if wire_buf is not None:
+                self.pack_stats["pack_inplace"] += 1
+            elif not self.config.zero_copy_ingest:
+                self.pack_stats["pack_fallback_disabled"] += 1
+            else:
+                self.pack_stats[
+                    f"pack_fallback_{fallback_reason}"] += 1
+        if use_l7:
+            wire, path_dict = pack_batch_l7dict(
+                b, path_words=l7_path_words, min_rows=l7_min_rows,
+                force_full=use_wide, out=wire_buf)
+            with self._pack_lock:           # dict geometry stays grow-only
+                self._l7_dict_rows = max(self._l7_dict_rows,
+                                         path_dict.shape[0])
+        elif not use_wide:
+            wire = pack_batch_v4(b, out=wire_buf)
+        else:
+            wire = pack_batch(b, l7=False, out=wire_buf)
+        return wire, path_dict, wire_key, wire_buf
+
+    @staticmethod
+    def _columnar(batch):
+        """Already-columnar staged batches (the pipeline's staging ring,
+        the shim feeder's harvest buffers) skip the per-batch dict copy;
+        only mixed/jax-array pytrees still pay the conversion."""
+        if all(type(v) is np.ndarray for v in batch.values()):
+            return batch
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+    def classify_async(self, placed, snap, batch, now, pre_steered=False):
         """Async dispatch (SURVEY.md §5 / the pipeline's overlap stage):
         host packing + transfer + XLA enqueue happen here, synchronously and
         in CT order; the returned finalize materializes the out pytree to
@@ -338,78 +459,16 @@ class JITDatapath(DatapathBackend):
         in-flight steps by itself."""
         jnp = self._jnp
         if self._sharded:
-            return self._classify_async_sharded(placed, snap, batch, now)
-        from cilium_tpu.kernels.records import (
-            PACK4_EP_SLOT_MAX, PACK4_L7_WORDS, PACK4_WORDS,
-            PACK_L7DICT_WORDS, PACK_WORDS, _path_words_of, pack_batch,
-            pack_batch_l7dict, pack_batch_v4)
+            return self._classify_async_sharded(placed, snap, batch, now,
+                                                pre_steered=pre_steered)
         # observe/trace: the pack/transfer/compute split attaches to the
         # caller's current trace context (pipeline worker or
         # Engine.classify), whichever tracer instance set it
         tracer, trace_id = active_trace()
         with tracer.span(trace_id, "datapath.pack"):
-            # already-columnar staged batches (the pipeline's staging ring,
-            # the shim feeder's harvest buffers) skip the per-batch dict
-            # copy; only mixed/jax-array pytrees still pay the conversion
-            if all(type(v) is np.ndarray for v in batch.values()):
-                b = batch
-            else:
-                b = {k: np.asarray(v) for k, v in batch.items()}
-            batch_l7 = bool(
-                (b["http_method"] != C.HTTP_METHOD_ANY).any()
-                or b["http_path"].any())
-            batch_wide = bool(
-                b["is_v6"].any()
-                or int(b["ep_slot"].max(initial=0)) > PACK4_EP_SLOT_MAX)
-            path_dict = None
-            n_rows = int(b["valid"].shape[0])
-            zero_copy = self.config.zero_copy_ingest
-            # the lock covers only widen-then-choose + the pool checkout
-            # (a concurrent place() reset can only land before or after
-            # this batch's whole format decision, never between); the
-            # column writes themselves run outside it — they touch only
-            # the private wire_buf, and serializing them would double
-            # pack latency whenever a control-plane classify (health
-            # probe, CLI) overlaps the pipeline worker. L7 widening is
-            # POLICY-gated: with zero L7 rule sets, tokens cannot affect
-            # any verdict — shipping them is pure wire waste, and
-            # skipping them keeps tokenized traffic under an L7-free
-            # policy on the compact wire permanently (no reset/re-widen
-            # retrace flap across regens).
-            with self._pack_lock:
-                if snap.l7.n_sets > 0:
-                    self._wire_l7 |= batch_l7
-                self._wire_wide |= batch_wide
-                self._batches_since_wide = 0 if batch_wide \
-                    else self._batches_since_wide + 1
-                use_l7, use_wide = self._wire_l7, self._wire_wide
-                if use_l7:
-                    self._l7_path_words = max(self._l7_path_words,
-                                              _path_words_of(b["http_path"]))
-                    l7_path_words = self._l7_path_words
-                    l7_min_rows = self._l7_dict_rows
-                    words = (PACK_L7DICT_WORDS if use_wide
-                             else PACK4_L7_WORDS)
-                elif not use_wide:
-                    words = PACK4_WORDS
-                else:
-                    words = PACK_WORDS
-                wire_buf = self._wire_buf(n_rows, words) if zero_copy \
-                    else None
-                wire_key = (n_rows, words) if wire_buf is not None else None
-                self.pack_stats["pack_inplace" if wire_buf is not None
-                                else "pack_fallback"] += 1
-            if use_l7:
-                wire, path_dict = pack_batch_l7dict(
-                    b, path_words=l7_path_words, min_rows=l7_min_rows,
-                    force_full=use_wide, out=wire_buf)
-                with self._pack_lock:       # dict geometry stays grow-only
-                    self._l7_dict_rows = max(self._l7_dict_rows,
-                                             path_dict.shape[0])
-            elif not use_wide:
-                wire = pack_batch_v4(b, out=wire_buf)
-            else:
-                wire = pack_batch(b, l7=False, out=wire_buf)
+            b = self._columnar(batch)
+            wire, path_dict, wire_key, wire_buf = self._pack_wire(
+                b, snap, pooled=True, fallback_reason="shape")
         with tracer.span(trace_id, "datapath.transfer",
                          bytes=int(wire.nbytes)):
             # chaos point: a wedged/failed host→device link (hang mode is
@@ -478,7 +537,11 @@ class JITDatapath(DatapathBackend):
             with self._pack_lock:
                 self.pack_stats["upload_cache_hits"] += 1
             return cached_dev
-        dev = self._jnp.asarray(path_dict)
+        if self._sharded:
+            import jax
+            dev = jax.device_put(path_dict, self._repl_sharding)
+        else:
+            dev = self._jnp.asarray(path_dict)
         with self._pack_lock:
             self.pack_stats["upload_cache_misses"] += 1
             # the dict is a fresh np.unique product (never pool-aliased):
@@ -487,24 +550,79 @@ class JITDatapath(DatapathBackend):
             self._path_dict_dev = dev
         return dev
 
-    def _classify_async_sharded(self, placed, snap, batch, now):
+    def _classify_async_sharded(self, placed, snap, batch, now,
+                                pre_steered=False):
+        """The meshed overlap stage. Pre-steered batches (the pipeline's
+        sharded staging ring delivers rows already grouped into equal
+        per-shard segments) pack IN PLACE into one pooled wire buffer whose
+        segments are exactly the per-chip transfers (P('flows') splits dim
+        0 on the segment boundaries) — the per-batch steer→allocate→pack
+        chain of the pre-PR-6 path is gone, and finalize returns outputs in
+        the steered geometry (the caller un-steers; the pipeline does it
+        per-slice while gathering ticket rows, which IS the
+        unsteer-on-finalize that keeps FIFO verdicts bit-identical).
+
+        Un-steered batches (the synchronous control-plane entry: health
+        probes, CLI classify) steer here with the classic allocating
+        regroup — counted ``pack_fallback_steered`` so a residual allocating
+        dispatch on the serving path is attributable — and finalize
+        un-steers back to the caller's row order. A rules-only mesh
+        (n_flow_shards == 1) needs no row grouping at all: every batch
+        counts as pre-steered."""
+        import jax
         from cilium_tpu.parallel.mesh import steer_batch, unsteer_outputs
         jnp = self._jnp
         tracer, trace_id = active_trace()
-        # steering must hash the post-DNAT tuple (service flows' CT entries
-        # live under the translated tuple) — same translation the shim runs
-        lb = snap.lb if snap.lb.n_frontends else None
-        with tracer.span(trace_id, "datapath.pack"):
-            # the steered multi-shard layout has no in-place variant yet
-            with self._pack_lock:
-                self.pack_stats["pack_fallback"] += 1
-            steered, scatter, _per = steer_batch(
-                batch, self.n_flow_shards, lb=lb, round_to_pow2=True)
-        with tracer.span(trace_id, "datapath.transfer"):
+        pre = pre_steered or self.n_flow_shards == 1
+        scatter = None
+        with tracer.span(trace_id, "datapath.pack",
+                         shards=self.n_flow_shards):
+            b = self._columnar(batch)
+            if not pre:
+                # steering must hash the post-DNAT tuple (service flows' CT
+                # entries live under the translated tuple) — the same
+                # translation the shim/feeder runs when it pre-bins
+                lb = snap.lb if snap.lb.n_frontends else None
+                with tracer.span(trace_id, "datapath.steer"):
+                    b, scatter, _per = steer_batch(
+                        b, self.n_flow_shards, lb=lb, round_to_pow2=True)
+            n_rows = int(b["valid"].shape[0])
+            if n_rows % self.n_flow_shards:
+                raise ValueError(
+                    f"pre-steered batch rows ({n_rows}) must divide into "
+                    f"{self.n_flow_shards} flow shards")
+            if not self.config.zero_copy_ingest:
+                # legacy dict dispatch (12 P('flows') column transfers);
+                # shard_map in_specs mirror the exact key-set, so staging
+                # extras must not ride along
+                with self._pack_lock:
+                    self.pack_stats["pack_fallback_disabled"] += 1
+                wire = path_dict = None
+                wire_key = wire_buf = None
+                dict_batch = {k: b[k] for k in self._BATCH_KEYS}
+                nbytes = sum(v.nbytes for v in dict_batch.values())
+            else:
+                dict_batch = None
+                # attribution: a pre-steered batch that still allocates can
+                # only do so for a pool-unfriendly shape; only the
+                # allocating-regroup path above earns the "steered" label
+                wire, path_dict, wire_key, wire_buf = self._pack_wire(
+                    b, snap, pooled=pre,
+                    fallback_reason="shape" if pre else "steered")
+                nbytes = int(wire.nbytes)
+        with tracer.span(trace_id, "datapath.transfer", bytes=nbytes,
+                         shards=self.n_flow_shards):
             FAULTS.fire("datapath.transfer")
+            if dict_batch is not None:
+                dev_batch = dict_batch       # the jit shards the columns
+            elif path_dict is not None:
+                dev_batch = (jax.device_put(wire, self._batch_sharding),
+                             self._upload_path_dict(path_dict))
+            else:
+                dev_batch = jax.device_put(wire, self._batch_sharding)
             with self._ct_lock:
                 out, new_ct, counters = self._classify(
-                    placed, self._ct, steered, jnp.uint32(now),
+                    placed, self._ct, dev_batch, jnp.uint32(now),
                     jnp.int32(snap.world_index))
                 self._ct = new_ct
 
@@ -513,7 +631,11 @@ class JITDatapath(DatapathBackend):
                 out_np = {k: np.asarray(v) for k, v in out.items()}
                 counters_np = {k: np.asarray(v)
                                for k, v in counters.items()}
-            return unsteer_outputs(out_np, scatter), counters_np
+            if wire_key is not None:
+                self._wire_buf_release(wire_key, wire_buf)
+            if scatter is not None:
+                out_np = unsteer_outputs(out_np, scatter)
+            return out_np, counters_np
         return finalize
 
     def sweep(self, now: int) -> int:

@@ -120,6 +120,7 @@ class Engine:
         self._mesh = None          # ClusterMesh when cluster_store set
         self._pipeline = None      # ingestion Pipeline, started on demand
         self._pipeline_stopped = False   # stop() bars lazy restart
+        self._pipeline_sharded = False   # pipeline delivers steered batches
         self._feeder = None        # shim/feeder.py harvest thread
         self._pack_stats_seen: Dict[str, int] = {}  # scrape-delta baseline
         self._pack_fold_lock = threading.Lock()     # concurrent scrapes
@@ -398,6 +399,12 @@ class Engine:
                     raise PipelineClosed(
                         "engine stopped; no new pipeline submissions")
                 cfg = self.config
+                # flow-sharded backends (the multi-chip mesh) want batches
+                # pre-steered: the pipeline's staging ring grows per-shard
+                # segments and steers at stage-write time, so one submit()
+                # saturates every chip behind the one admission queue
+                shards = getattr(self.datapath, "pipeline_shards", 1)
+                self._pipeline_sharded = shards > 1
                 self._pipeline = Pipeline(
                     self._pipeline_dispatch, metrics=self.metrics,
                     max_bucket=cfg.batch_size,
@@ -412,7 +419,17 @@ class Engine:
                     breaker_cooldown_s=cfg.pipeline_breaker_cooldown_s,
                     stall_timeout_s=cfg.pipeline_stall_timeout_s,
                     max_restarts=cfg.pipeline_max_restarts,
-                    restart_backoff_s=cfg.pipeline_restart_backoff_s)
+                    restart_backoff_s=cfg.pipeline_restart_backoff_s,
+                    n_shards=shards,
+                    shard_fn=self._pipeline_shard_of if shards > 1
+                    else None,
+                    shard_headroom=cfg.pipeline_shard_headroom,
+                    # pre-binned shards are only trusted while the binning
+                    # revision is still active (LB changes move the
+                    # post-DNAT steer hash)
+                    shard_rev_fn=(lambda: self._active.revision
+                                  if self._active is not None else -1)
+                    if shards > 1 else None)
             return self._pipeline
 
     def submit(self, batch: Dict[str, np.ndarray],
@@ -440,11 +457,30 @@ class Engine:
         pl = self._pipeline
         return pl.stats() if pl is not None else None
 
-    def _pipeline_dispatch(self, batch: Dict[str, np.ndarray], now: int):
+    def _pipeline_shard_of(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Per-row flow-shard ids for the sharded staging ring: the
+        direction-normalized flow hash over the ACTIVE snapshot's LB tables
+        (service flows steer by their post-DNAT tuple — the same
+        translation the datapath and the shim run), mod the mesh's flow
+        axis. Called from the pipeline worker at stage-write time for rows
+        the producer didn't pre-bin."""
+        from cilium_tpu.parallel.mesh import flow_shard_of
+        snap = self.active.snapshot
+        lb = snap.lb if snap.lb.n_frontends else None
+        return flow_shard_of(batch, self.datapath.pipeline_shards, lb=lb)
+
+    def _pipeline_dispatch(self, batch: Dict[str, np.ndarray], now: int,
+                           steer_rev: Optional[int] = None):
         """One microbatch through the datapath (called from the pipeline
         worker). Captures the active snapshot per dispatch — same revision
         fencing as classify — and defers metrics/flow-log to finalize, when
-        the verdicts are actually on the host."""
+        the verdicts are actually on the host. ``steer_rev`` (sharded
+        pipelines) is the revision the bucket was steered under; when a
+        regen slipped in between stage-write and now, the batch is handed
+        to the datapath un-pre-steered so it re-steers against THIS
+        snapshot's LB tables (counted ``pack_fallback_steered`` — rare and
+        attributable) instead of stranding service flows' CT entries on
+        the wrong shard."""
         active = self.active
         raw = batch.get("_ep_raw")
         if raw is not None and raw.any():
@@ -469,8 +505,20 @@ class Engine:
             batch["ep_slot"][good] = slots[good]
             batch["valid"] &= ~(has & (slots < 0))
         with self.metrics.span("pipeline_dispatch").timer():
-            fin = self.datapath.classify_async(
-                active.tensors, active.snapshot, batch, now)
+            # a sharded pipeline's staging ring delivers rows already
+            # grouped into per-shard segments: the datapath packs them in
+            # place and ships each chip its own segment — verdicts come
+            # back in the steered geometry, un-steered per-ticket by the
+            # pipeline's finalize gather. The kwarg rides only on sharded
+            # engines so duck-typed 4-arg backends stay compatible.
+            if self._pipeline_sharded:
+                fin = self.datapath.classify_async(
+                    active.tensors, active.snapshot, batch, now,
+                    pre_steered=steer_rev is not None
+                    and steer_rev == active.revision)
+            else:
+                fin = self.datapath.classify_async(
+                    active.tensors, active.snapshot, batch, now)
 
         def finalize():
             out, counters = fin()
@@ -508,6 +556,11 @@ class Engine:
                 pool_batches=cfg.ingest_pool_batches,
                 poll_budget=cfg.ingest_poll_budget,
                 idle_sleep_s=cfg.ingest_idle_sleep_s,
+                # sharded mesh: harvest computes the flow-shard hash during
+                # ep-slot mapping (vectorized, shares flow_shard_of) so the
+                # staging ring's flush-time scatter is a copy, not a
+                # re-hash — the feeder IS the software RSS
+                n_shards=getattr(self.datapath, "pipeline_shards", 1),
                 metrics=self.metrics, tracer=self.tracer).start()
             return self._feeder
 
@@ -627,6 +680,9 @@ class Engine:
                 "state": pstate,
                 "restarts": ps["restarts"],
                 "breaker": ps["breaker"],
+                # per-mesh guard surface: a non-ok state fences this many
+                # chips at once (no half-mesh verdicts)
+                "shards": ps.get("n_shards", 1),
             }
             from cilium_tpu.pipeline.guard import PIPELINE_STATES
             self.metrics.set_gauge("pipeline_state",
@@ -700,14 +756,24 @@ class Engine:
         textfile exporter)."""
         # zero-copy ingestion attribution: fold the datapath's monotone
         # pack/upload ints in as real counters (delta since last scrape —
-        # a *_total gauge would trip PromQL counter semantics)
+        # a *_total gauge would trip PromQL counter semantics). The
+        # fallback split exports as ONE labeled counter family so residual
+        # allocating packs are attributable: disabled (zero-copy off),
+        # steered (sharded batch arrived un-steered), shape (unpoolable
+        # row count).
         pack = getattr(self.datapath, "pack_stats", None)
         if pack:
             with self._pack_fold_lock:   # API scrape vs textfile flush
                 for k, v in pack.items():
                     d = v - self._pack_stats_seen.get(k, 0)
                     if d:
-                        self.metrics.inc_counter(f"datapath_{k}_total", d)
+                        if k.startswith("pack_fallback_"):
+                            reason = k[len("pack_fallback_"):]
+                            name = ("datapath_pack_fallback_total"
+                                    f'{{reason="{reason}"}}')
+                        else:
+                            name = f"datapath_{k}_total"
+                        self.metrics.inc_counter(name, d)
                         self._pack_stats_seen[k] = v
         return (self.metrics.render_prometheus()
                 + self.flowmetrics.render_prometheus())

@@ -200,8 +200,14 @@ class Metrics:
                     lines.append(f"# TYPE ciliumtpu_{base} counter")
                     typed.add(base)
                 lines.append(f"ciliumtpu_{name} {v}")
+            # gauges may carry labels too (``pipeline_staged_rows{shard=..}``)
+            # — one TYPE line per base metric, like the counters above
+            gtyped = set()
             for name, g in sorted(self.gauges.items()):
-                lines.append(f"# TYPE ciliumtpu_{name} gauge")
+                base = name.split("{", 1)[0]
+                if base not in gtyped:
+                    lines.append(f"# TYPE ciliumtpu_{base} gauge")
+                    gtyped.add(base)
                 lines.append(f"ciliumtpu_{name} {g}")
             for name, s in sorted(self.spans.items()):
                 lines.append(f"# TYPE ciliumtpu_{name}_seconds summary")

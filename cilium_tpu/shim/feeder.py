@@ -97,6 +97,7 @@ class ShimFeeder:
                  pool_batches: int = 4,
                  poll_budget: int = 256,
                  idle_sleep_s: float = 0.0005,
+                 n_shards: int = 1,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  name: str = "feeder"):
@@ -106,16 +107,32 @@ class ShimFeeder:
                 "(the shim ages out unverdicted batches past that)")
         if poll_budget < 1:
             raise ValueError("poll_budget must be >= 1")
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
         self.shim = shim
         self.engine = engine
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else TRACER
         self._poll_budget = poll_budget
         self._idle_sleep_s = idle_sleep_s
+        self._n_shards = n_shards
         self._name = name
 
         self._free: deque = deque(shim.make_poll_buffer()
                                   for _ in range(pool_batches))
+        if n_shards > 1:
+            # software RSS (SURVEY §2): harvest pre-bins each record by the
+            # direction-normalized flow hash so the pipeline's flush-time
+            # scatter is a plain copy, never a re-hash. The column carries
+            # the SHARD_BIN encoding — shard+1 in the low bits (0 = "not
+            # binned", the staging ring's convention for optional ``_*``
+            # columns) and the binning policy revision above, so a bin
+            # hashed under a superseded LB table is re-hashed at
+            # stage-write instead of stranding a service flow's CT entry
+            # on the wrong shard — and rides the same reusable poll
+            # buffers.
+            for buf in self._free:
+                buf["_shard"] = np.zeros((shim.batch_size,), dtype=np.int64)
         self._pending: deque = deque()     # (ticket, buf) in harvest order
         self._zeros = np.zeros((shim.batch_size,), dtype=bool)
         self._stop = threading.Event()
@@ -297,6 +314,17 @@ class ShimFeeder:
         unknown = slots < 0
         b["ep_slot"][:] = np.where(unknown, 0, slots)
         b["valid"] &= ~unknown
+        if self._n_shards > 1:
+            # pre-bin while the columns are already hot in cache: the same
+            # direction-normalized hash (post-DNAT tuple) the datapath and
+            # the staging ring use, revision-stamped so a regen between
+            # harvest and stage-write invalidates the bin rather than
+            # mis-steering it
+            from cilium_tpu.parallel.mesh import flow_shard_of
+            from cilium_tpu.pipeline.scheduler import shard_bin_encode
+            lb = snap.lb if snap.lb.n_frontends else None
+            b["_shard"][:] = shard_bin_encode(
+                flow_shard_of(b, self._n_shards, lb=lb), snap.revision)
         return int(b["valid"].sum())
 
     # -- verdict application (FIFO) -------------------------------------------
