@@ -9,7 +9,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
-        ingest-smoke multichip-smoke shim bench clean
+        ingest-smoke multichip-smoke audit-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -45,7 +45,19 @@ multichip-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_parallel.py tests/test_sharded_pipeline.py -q -m "not slow"
 	$(PYTEST_ENV) python -m pytest tests/test_sharded_pipeline.py -q -m slow
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke
+# Verdict-provenance gate (observe/audit.py + observe/blackbox.py): the
+# tier-1 audit subset — deterministic capture sampling, bounded-pool
+# skipped accounting, the audit.corrupt detection drill (health DEGRADED +
+# frozen debug bundle with the offending rows/revision), wedged-auditor
+# serving survival, e2e SLO plumbing, scrape-race + trace-wraparound
+# satellites — plus the slow-marked 10k-submission soak with the auditor
+# armed at sampling 1.0 (zero mismatches, checked > 0, then a
+# corruption-injection phase) and the <2%-overhead attestation.
+audit-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_audit.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_audit.py -q -m slow
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -135,6 +135,25 @@ def register(sub: "argparse._SubParsersAction") -> None:
     p.set_defaults(func=_cmd_trace)
 
     p = sub.add_parser(
+        "debug-bundle",
+        help="fetch the flight-recorder debug bundle from a live agent "
+             "(observe/blackbox.py): the frozen anomaly bundle — parity "
+             "mismatch, breaker open, watchdog restart, shed spike — or a "
+             "live snapshot when nothing froze; carries the guard/regen "
+             "event ring, verdict summaries, span tail, audit mismatch "
+             "rows + revision, and live engine state")
+    p.add_argument("--api", metavar="SOCKET", required=True,
+                   help="the running engine's REST socket")
+    p.add_argument("--out", metavar="FILE",
+                   help="write the JSON bundle to FILE (default: stdout)")
+    p.add_argument("--clear", action="store_true",
+                   help="re-arm the recorder after the fetch (the next "
+                        "anomaly freezes a fresh bundle)")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="json")
+    p.set_defaults(func=_cmd_debug_bundle)
+
+    p = sub.add_parser(
         "classify", help="serve one flow through a live agent's ingestion "
                          "pipeline (POST /v1/classify; the serving path "
                          "with guard semantics: 429 on overload shed, 503 "
@@ -767,6 +786,39 @@ def _cmd_trace(args) -> int:
             print(f"  trace={sp['trace_id']:<8} {sp['name']:<24} "
                   f"{sp['duration_ms']:.3f}ms"
                   + (f" {attrs}" if attrs else ""))
+    return 0
+
+
+def _cmd_debug_bundle(args) -> int:
+    """Fetch (and optionally persist) the flight-recorder bundle. Exit 0
+    always on a successful fetch — a live snapshot is a valid answer; the
+    ``frozen`` field says whether an anomaly captured it."""
+    path = "/v1/debug/bundle"
+    if args.clear:
+        path += "?clear=1"
+    doc = _live(args, "GET", path)
+    payload = json.dumps(doc, indent=2, default=str)
+    state = (f"frozen: {doc.get('reason')}" if doc.get("frozen")
+             else "live snapshot")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+        print(f"debug bundle ({state}) written to {args.out}")
+        return 0
+    if args.output == "text":
+        print(f"bundle: {state} "
+              f"(freezes_total={doc.get('freezes_total')})")
+        for e in doc.get("events", [])[-20:]:
+            attrs = {k: v for k, v in e.items()
+                     if k not in ("t", "mono", "kind")}
+            print(f"  [{e.get('t'):.3f}] {e.get('kind'):<16} {attrs}")
+        eng = doc.get("engine", {})
+        aud = eng.get("audit") or {}
+        print(f"audit: checked={aud.get('checked_rows')} "
+              f"mismatched={aud.get('mismatched_rows')} "
+              f"skipped={aud.get('skipped_batches')}")
+        return 0
+    print(payload)
     return 0
 
 

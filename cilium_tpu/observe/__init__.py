@@ -15,10 +15,21 @@ Three parts behind one package:
                     already exports and adjusts ``pipeline_flush_ms`` and
                     the active bucket floor within configured bounds
                     (hysteresis + capped steps, off by default).
+- ``audit``       — shadow-oracle parity auditor: counter-samples finalized
+                    batches, replays them against ``oracle/datapath.py`` in
+                    a background controller, and compares verdicts
+                    bit-for-bit — the paper's parity claim as a continuous
+                    production observable.
+- ``blackbox``    — always-on bounded flight recorder: guard/regen/audit
+                    event ring + verdict summaries + span tail, frozen into
+                    an exportable JSON debug bundle on anomaly.
 """
 
 from cilium_tpu.observe.trace import TRACER, Tracer  # noqa: F401
 from cilium_tpu.observe.flowmetrics import FlowMetrics  # noqa: F401
 from cilium_tpu.observe.autotune import Autotuner  # noqa: F401
+from cilium_tpu.observe.audit import ShadowAuditor  # noqa: F401
+from cilium_tpu.observe.blackbox import FlightRecorder  # noqa: F401
 
-__all__ = ["TRACER", "Tracer", "FlowMetrics", "Autotuner"]
+__all__ = ["TRACER", "Tracer", "FlowMetrics", "Autotuner", "ShadowAuditor",
+           "FlightRecorder"]

@@ -1,0 +1,440 @@
+"""Shadow-oracle parity audit: verdict provenance as a production observable.
+
+The paper's headline claim — flow verdicts bit-identical to the eBPF
+datapath semantics — is pinned at test time by tests/test_parity.py, but
+the serving stack reshapes verdicts continuously (steered staging, pooled
+wire buffers, dispatch-time slot remaps, per-mesh restarts) and none of
+that machinery is exercised by a one-shot test under the exact policy
+revision a production batch classified against. This module makes parity a
+*runtime* signal:
+
+- **Capture at finalize** (:meth:`ShadowAuditor.maybe_capture`, called from
+  the engine's finalize path): counter-sampled — the same deterministic
+  sampling discipline as ``observe/trace.py``, one counter draw per
+  finalized batch on the unsampled path — a sampled batch's valid rows are
+  copied out together with everything replay needs *despite CT mutation*:
+  the columnar rows as classified (post slot-remap, post steering), the
+  captured out columns (whose ``status`` IS the CT probe result as-of
+  classification), the snapshot the batch classified under (revision
+  fence), the per-row flow-shard id, and the wire format in use. The
+  capture pool is bounded: when the background replay lags, new captures
+  are dropped and counted in ``parity_audit_skipped_total`` — the serving
+  path never blocks on its own auditor.
+- **Replay in the background** (:meth:`step`, driven by the engine's
+  ``parity-audit`` controller): each captured row is rebuilt into an
+  oracle :class:`PacketRecord` and re-derived through
+  ``oracle.Oracle.replay`` — service DNAT, ipcache LPM, the policy ladder,
+  L7 matching — with the captured CT status as the conntrack truth, then
+  compared bit-for-bit: allow, drop reason, remote identity, redirect,
+  and the service DNAT target. A diverging row's implied CT delta
+  (create/update/none, derived from allow+status) is reported alongside
+  as the conntrack consequence of the wrong verdict. Reply un-DNAT
+  fields are checked structurally (rnat requires REPLY status) since the
+  rev-NAT id lives in the live CT entry, not the probe input.
+- **Accounting**: ``parity_audit_checked_total`` (rows replayed),
+  ``parity_audit_skipped_total`` (pool-saturation drops),
+  ``parity_audit_mismatched_total{revision="N"}`` (one labeled series per
+  offending policy revision — sum over labels for the total). A mismatch
+  folds into ``Engine.health()`` as DEGRADED and fires ``on_mismatch`` —
+  the engine points that at the flight recorder
+  (``observe/blackbox.py``), freezing a debug bundle that carries the
+  offending rows and revision.
+
+Fault tolerance is the design constraint: every entry point the serving
+path touches is wrapped never-raise (errors are counted + throttled-
+logged), the capture pool is bounded, and the replay side runs in a
+supervised controller — a crashed, wedged, or killed auditor degrades to
+``skipped`` accounting, never to a stalled pipeline. The ``audit.corrupt``
+fault point (runtime/faults.py) deliberately flips the captured allow bits
+so chaos drills can prove the detector actually detects.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu.observe.trace import TRACER, Tracer
+from cilium_tpu.runtime.faults import FAULTS, FaultInjected
+from cilium_tpu.runtime.metrics import Metrics
+from cilium_tpu.utils import constants as C
+
+log = logging.getLogger("cilium_tpu.audit")
+
+#: out columns a capture snapshots (the verdict surface the replay compares;
+#: rnat fields ride along for the structural consistency check)
+AUDIT_OUT_KEYS = ("allow", "reason", "status", "remote_identity",
+                  "redirect", "svc", "nat_dst", "nat_dport", "rnat")
+
+#: batch columns a capture snapshots (the classify inputs; ``_``-prefixed
+#: staging extras are deliberately excluded — they are transport metadata,
+#: not semantics)
+AUDIT_ROW_KEYS = ("src", "dst", "sport", "dport", "proto", "tcp_flags",
+                  "is_v6", "ep_slot", "direction", "http_method",
+                  "http_path")
+
+#: detail records retained per auditor (memory bound; the flight recorder
+#: bundle carries the tail)
+MAX_MISMATCH_RECORDS = 16
+
+
+def _ct_delta(allow: bool, status: int, create: bool) -> str:
+    """The CT mutation this verdict implies — what the device table must
+    have done for this row. Reported with a mismatch as its conntrack
+    consequence: the datapath does not expose its per-row mutation
+    decision, so the delta is DERIVED from (allow, status), never an
+    independent bit-compare — it diverges exactly when ``allow`` does,
+    and then tells the operator which table tear the wrong verdict
+    caused (a phantom create, or a dropped update)."""
+    if not allow:
+        return "none"
+    if status == C.CTStatus.NEW:
+        return "create" if create else "none"
+    return "update"
+
+
+class _Capture:
+    """One sampled finalized batch, frozen for replay."""
+
+    __slots__ = ("rows", "out", "snap", "now", "shard", "wire", "n_rows",
+                 "t_mono", "corrupted")
+
+    def __init__(self, rows, out, snap, now, shard, wire, n_rows, corrupted):
+        self.rows = rows            # {col: np.ndarray[k]} copied valid rows
+        self.out = out              # {col: np.ndarray[k]} captured verdicts
+        self.snap = snap            # the PolicySnapshot classified against
+        self.now = now
+        self.shard = shard          # np.ndarray[k] flow-shard per row (or None)
+        self.wire = wire            # wire-format tag ("fake"/"v4"/"wide"/"l7")
+        self.n_rows = n_rows        # valid rows in the source batch
+        self.t_mono = time.monotonic()
+        self.corrupted = corrupted  # audit.corrupt fault flipped the bits
+
+
+class ShadowAuditor:
+    """Counter-sampled capture + background oracle replay; see module doc.
+
+    Constructed once per engine. ``maybe_capture`` is the serving-path
+    entry (never raises); ``step`` is the controller body (replays pending
+    captures, bounded by ``budget``)."""
+
+    def __init__(self, *, sample_rate: float = 1 / 64,
+                 pool_batches: int = 8, max_rows: int = 512,
+                 n_shards: int = 1,
+                 metrics: Optional[Metrics] = None,
+                 tracer: Optional[Tracer] = None,
+                 on_mismatch: Optional[Callable[[Dict], None]] = None):
+        if pool_batches < 1 or max_rows < 1:
+            raise ValueError("pool_batches and max_rows must be >= 1")
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.tracer = tracer if tracer is not None else TRACER
+        self.on_mismatch = on_mismatch
+        self._pool = pool_batches
+        self._max_rows = max_rows
+        self._n_shards = n_shards
+        self._lock = threading.Lock()
+        self._pending: Deque[_Capture] = deque()
+        self._events = itertools.count()
+        self.configure(sample_rate=sample_rate)
+        # replay-side oracle cache: keyed by snapshot identity, exactly the
+        # FakeDatapath revision-fencing discipline — a capture replays
+        # against the snapshot it classified under, never a newer one
+        self._oracle = None
+        self._oracle_snap = None
+
+        # stats (reads via stats(); writes under self._lock)
+        self.captured_batches = 0
+        self.checked_rows = 0
+        self.checked_batches = 0
+        self.mismatched_rows = 0
+        self.mismatched_batches = 0
+        self.skipped_batches = 0
+        self.capture_errors = 0
+        self.replay_errors = 0
+        self.last_mismatch_revision: Optional[int] = None
+        self.mismatches: Deque[Dict] = deque(maxlen=MAX_MISMATCH_RECORDS)
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, sample_rate: Optional[float] = None) -> None:
+        if sample_rate is not None:
+            if sample_rate <= 0:
+                self._every = 0
+            elif sample_rate >= 1.0:
+                self._every = 1
+            else:
+                self._every = max(1, round(1.0 / sample_rate))
+
+    @property
+    def sample_rate(self) -> float:
+        return 0.0 if self._every == 0 else 1.0 / self._every
+
+    # -- serving-path side (never raises) ------------------------------------
+    def maybe_capture(self, batch: Dict[str, np.ndarray],
+                      out: Dict[str, np.ndarray], snap, now: int,
+                      steered: bool = False) -> None:
+        """Counter-sampled capture of one finalized batch. The unsampled
+        path pays one counter draw + a modulo; the sampled path copies the
+        valid rows (bounded by ``max_rows``) into the replay pool.
+        ``steered``: the batch is in the sharded pipeline's steered
+        geometry (row → flow shard = row // seg_cap), which is what makes
+        per-shard mismatch attribution meaningful. Any internal failure is
+        counted, never propagated — the auditor can never take the
+        serving path down with it."""
+        every = self._every
+        if every == 0:
+            return
+        n = next(self._events)
+        if every != 1 and n % every:
+            return
+        try:
+            self._capture(batch, out, snap, now, steered)
+        except Exception:   # noqa: BLE001 — the serving path is sacred
+            with self._lock:
+                self.capture_errors += 1
+                errs = self.capture_errors
+            self.metrics.inc_counter("parity_audit_capture_errors_total")
+            if errs <= 3 or errs % 100 == 0:
+                log.exception("audit capture failed (%d); serving "
+                              "unaffected", errs)
+
+    def _capture(self, batch, out, snap, now, steered: bool) -> None:
+        with self._lock:
+            if len(self._pending) >= self._pool:
+                # the replay side is lagging (or wedged/dead): shed the
+                # capture, never the batch — skipped accounting is the
+                # proof the auditor was saturated, not silently idle
+                self.skipped_batches += 1
+                self.metrics.inc_counter("parity_audit_skipped_total")
+                return
+        valid = np.asarray(batch["valid"])
+        idx = np.nonzero(valid)[0]
+        if idx.size == 0:
+            return
+        if idx.size > self._max_rows:
+            idx = idx[: self._max_rows]   # deterministic prefix, bounded copy
+        rows = {k: np.asarray(batch[k])[idx].copy()
+                for k in AUDIT_ROW_KEYS if k in batch}
+        out_rows = {k: np.asarray(out[k])[idx].copy()
+                    for k in AUDIT_OUT_KEYS if k in out}
+        # the corruption drill: an armed ``audit.corrupt`` point flips the
+        # captured allow bits — a stand-in for a datapath/kernels bug the
+        # auditor exists to catch (the capture is a copy; live verdicts
+        # are untouched)
+        corrupted = False
+        try:
+            FAULTS.fire("audit.corrupt")
+        except FaultInjected:
+            out_rows["allow"] = ~out_rows["allow"]
+            corrupted = True
+        shard = None
+        if steered and self._n_shards > 1:
+            # a steered bucket's row i lives in segment i // seg_cap — the
+            # per-chip attribution for mismatch labels (sync/control-plane
+            # batches are NOT in steered geometry; they capture shard-less)
+            seg = max(1, valid.shape[0] // self._n_shards)
+            shard = (idx // seg).astype(np.int32)
+        wire = "wide" if bool(rows.get("is_v6", np.False_).any()) else "v4"
+        if bool((rows["http_method"] != C.HTTP_METHOD_ANY).any()
+                or rows["http_path"].any()):
+            wire = "l7"
+        cap = _Capture(rows, out_rows, snap, now, shard, wire,
+                       int(idx.size), corrupted)
+        with self._lock:
+            if len(self._pending) >= self._pool:
+                self.skipped_batches += 1
+                self.metrics.inc_counter("parity_audit_skipped_total")
+                return
+            self._pending.append(cap)
+            self.captured_batches += 1
+        self.metrics.inc_counter("parity_audit_captured_total")
+        self.metrics.set_gauge("parity_audit_pending", len(self._pending))
+
+    # -- replay side (background controller) ---------------------------------
+    def step(self, budget: Optional[int] = None) -> Dict:
+        """Replay pending captures against the oracle (the ``parity-audit``
+        controller body). Bounded by ``budget`` batches (None = drain).
+        Returns a summary dict; replay failures are counted + logged and
+        never abort the sweep."""
+        replayed = mismatched = 0
+        while budget is None or replayed < budget:
+            with self._lock:
+                cap = self._pending.popleft() if self._pending else None
+            if cap is None:
+                break
+            try:
+                with self.tracer.span(self.tracer.current(), "audit.replay",
+                                      rows=cap.n_rows):
+                    mismatched += self._replay(cap)
+            except Exception:   # noqa: BLE001 — supervised degradation
+                with self._lock:
+                    self.replay_errors += 1
+                    errs = self.replay_errors
+                self.metrics.inc_counter("parity_audit_replay_errors_total")
+                if errs <= 3 or errs % 100 == 0:
+                    log.exception("audit replay failed (%d)", errs)
+            replayed += 1
+        self.metrics.set_gauge("parity_audit_pending", len(self._pending))
+        return {"replayed": replayed, "mismatched": mismatched,
+                "pending": len(self._pending)}
+
+    def _oracle_for(self, snap):
+        from oracle import Oracle
+        if self._oracle is None or self._oracle_snap is not snap:
+            # the shared snapshot→oracle construction (the fake datapath
+            # uses the same classmethod); the CT table stays empty — it is
+            # never probed, replay() takes the captured status instead
+            self._oracle = Oracle.for_snapshot(snap)
+            self._oracle_snap = snap
+        return self._oracle
+
+    def _replay(self, cap: _Capture) -> int:
+        from cilium_tpu.runtime.datapath import _records_from_batch
+        oracle = self._oracle_for(cap.snap)
+        rows = dict(cap.rows)
+        rows["valid"] = np.ones((cap.n_rows,), dtype=bool)
+        records = _records_from_batch(rows, cap.snap.ep_ids)
+        out = cap.out
+        bad_rows: List[Dict] = []
+        for i, p in enumerate(records):
+            got_allow = bool(out["allow"][i])
+            got_status = int(out["status"][i])
+            if p is None or p.ep_id == -1:
+                # slot out of range for this snapshot: fail-closed upstream
+                # should have invalidated the row; audit it as must-deny
+                if got_allow:
+                    bad_rows.append({"row": i, "field": "allow",
+                                     "want": False, "got": True,
+                                     "why": "unknown endpoint slot"})
+                continue
+            verdict, create = oracle.replay(p, got_status)
+            diffs = {}
+            if bool(verdict.allow) != got_allow:
+                diffs["allow"] = (bool(verdict.allow), got_allow)
+            if int(verdict.drop_reason) != int(out["reason"][i]):
+                diffs["reason"] = (int(verdict.drop_reason),
+                                   int(out["reason"][i]))
+            if int(verdict.remote_identity) != int(out["remote_identity"][i]):
+                diffs["remote_identity"] = (int(verdict.remote_identity),
+                                            int(out["remote_identity"][i]))
+            if "redirect" in out and \
+                    bool(verdict.redirect) != bool(out["redirect"][i]):
+                diffs["redirect"] = (bool(verdict.redirect),
+                                     bool(out["redirect"][i]))
+            if "svc" in out and bool(verdict.svc) != bool(out["svc"][i]):
+                diffs["svc"] = (bool(verdict.svc), bool(out["svc"][i]))
+            if verdict.svc and "nat_dst" in out:
+                want_nat = np.frombuffer(verdict.nat_dst, dtype=">u4")
+                if not np.array_equal(want_nat,
+                                      out["nat_dst"][i].astype(">u4")) \
+                        or int(verdict.nat_dport) != int(out["nat_dport"][i]):
+                    diffs["nat"] = ((want_nat.tolist(),
+                                     int(verdict.nat_dport)),
+                                    (out["nat_dst"][i].tolist(),
+                                     int(out["nat_dport"][i])))
+            # CT-delta annotation (see _ct_delta): the mutation the
+            # replayed verdict demands vs the one the captured verdict
+            # implies — only ever differs alongside an allow diff, where
+            # it names the resulting table tear
+            want_delta = _ct_delta(bool(verdict.allow), got_status, create)
+            got_delta = _ct_delta(got_allow, got_status, True)
+            if want_delta != got_delta:
+                diffs["ct_delta"] = (want_delta, got_delta)
+            # structural rnat check: reply un-DNAT without a REPLY CT hit
+            # is impossible by construction
+            if "rnat" in out and bool(out["rnat"][i]) \
+                    and got_status != C.CTStatus.REPLY:
+                diffs["rnat"] = ("status==REPLY required", got_status)
+            if diffs:
+                bad_rows.append({
+                    "row": i,
+                    "diffs": {k: {"want": w, "got": g}
+                              for k, (w, g) in diffs.items()},
+                    "shard": int(cap.shard[i]) if cap.shard is not None
+                    else 0,
+                    "flow": {
+                        "sport": int(p.src_port), "dport": int(p.dst_port),
+                        "proto": int(p.proto), "ep_id": int(p.ep_id),
+                        "direction": int(p.direction),
+                        "status": got_status,
+                    },
+                })
+        rev = int(cap.snap.revision)
+        with self._lock:
+            self.checked_batches += 1
+            self.checked_rows += cap.n_rows
+        self.metrics.inc_counter("parity_audit_checked_total", cap.n_rows)
+        if not bad_rows:
+            return 0
+        detail = {
+            "revision": rev,
+            "now": cap.now,
+            "wire": cap.wire,
+            "rows_checked": cap.n_rows,
+            "rows_mismatched": len(bad_rows),
+            "shards": sorted({r["shard"] for r in bad_rows}),
+            "corrupt_injected": cap.corrupted,
+            "rows": bad_rows[:8],        # bounded detail; counts carry the rest
+            "age_s": round(time.monotonic() - cap.t_mono, 3),
+        }
+        with self._lock:
+            self.mismatched_rows += len(bad_rows)
+            self.mismatched_batches += 1
+            self.last_mismatch_revision = rev
+            self.mismatches.append(detail)
+        # one labeled series per offending revision: summing over labels in
+        # PromQL gives the total, and the label answers "which policy world
+        # diverged" without a bundle fetch
+        self.metrics.inc_counter(
+            f'parity_audit_mismatched_total{{revision="{rev}"}}',
+            len(bad_rows))
+        self.tracer.event("audit.mismatch", revision=rev,
+                          rows=len(bad_rows), wire=cap.wire)
+        log.error("PARITY MISMATCH: %d/%d rows diverged from the shadow "
+                  "oracle at revision %d (wire=%s)", len(bad_rows),
+                  cap.n_rows, rev, cap.wire)
+        if self.on_mismatch is not None:
+            try:
+                self.on_mismatch(detail)
+            except Exception:   # noqa: BLE001 — the sink must not kill audit
+                log.exception("audit on_mismatch sink failed")
+        return len(bad_rows)
+
+    def rearm(self) -> None:
+        """Operator re-arm after a mismatch was investigated (the debug
+        bundle's ``--clear`` path): zero the mismatch state so health()
+        returns to OK and the NEXT divergence degrades it again.
+        checked/skipped accounting is deliberately kept — it is history,
+        not an alarm."""
+        with self._lock:
+            self.mismatched_rows = 0
+            self.mismatched_batches = 0
+            self.last_mismatch_revision = None
+            self.mismatches.clear()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def healthy(self) -> bool:
+        return self.mismatched_rows == 0
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "sample_rate": self.sample_rate,
+                "captured_batches": self.captured_batches,
+                "checked_batches": self.checked_batches,
+                "checked_rows": self.checked_rows,
+                "mismatched_batches": self.mismatched_batches,
+                "mismatched_rows": self.mismatched_rows,
+                "skipped_batches": self.skipped_batches,
+                "capture_errors": self.capture_errors,
+                "replay_errors": self.replay_errors,
+                "pending": len(self._pending),
+                "pool_batches": self._pool,
+                "last_mismatch_revision": self.last_mismatch_revision,
+            }

@@ -31,7 +31,8 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from cilium_tpu.observe.trace import TRACER, Tracer
-from cilium_tpu.runtime.metrics import Metrics, quantile_from
+from cilium_tpu.runtime.metrics import (Metrics, quantile_from,
+                                        quantile_is_empty)
 
 log = logging.getLogger("cilium_tpu.autotune")
 
@@ -110,7 +111,14 @@ class Autotuner:
             return None                  # idle interval: keep the baseline
         self._remember(counts, fill_rows, bucket_rows, dispatched, reasons)
 
-        p99_ms = quantile_from(buckets, d_counts, 0.99) * 1e3
+        p99 = quantile_from(buckets, d_counts, 0.99)
+        if quantile_is_empty(p99):
+            # batches dispatched but zero queue-wait observations this
+            # interval (a histogram reset / scrape race): no signal to act
+            # on — and the NaN sentinel must never reach the comparisons
+            # below, where every branch would silently read False
+            return None
+        p99_ms = p99 * 1e3
         fill = d_fill / d_bucket
         obs = {"queue_wait_p99_ms": round(p99_ms, 3),
                "fill_ratio": round(fill, 4),

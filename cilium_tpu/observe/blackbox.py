@@ -1,0 +1,220 @@
+"""Always-on bounded flight recorder: the debuggability half of verdict
+provenance.
+
+When the serving path misbehaves in production — a parity mismatch, a
+breaker opening, a watchdog restart, a shed spike — the evidence is gone by
+the time an operator attaches: the span ring has wrapped, the stats have
+moved on, the offending rows were recycled. The flight recorder keeps a
+small, always-on tail of exactly that evidence and **freezes** it into an
+exportable JSON debug bundle the moment an anomaly fires:
+
+- **Event ring** (bounded deque): guard/breaker/watchdog transitions and
+  sheds (fed by the pipeline's ``event_sink``), regeneration revisions,
+  parity-audit verdicts — each with wall + monotonic timestamps.
+- **Verdict summaries** (last N finalized batches): rows/allowed/dropped +
+  top drop reasons — what the datapath was answering right before the
+  anomaly, without retaining the batches themselves.
+- **Stats snapshots**: periodic pipeline/feeder/health snapshots noted by
+  the engine's observability flush.
+- **Span-ring tail**: the tracer's recent spans are folded into the bundle
+  at freeze time (per-stage latency context around the anomaly).
+
+Freeze policy: the FIRST anomaly wins — its bundle is the root-cause
+record and is kept until explicitly cleared (``clear()`` / the API's
+``?clear=1``), while ``freezes_total`` counts every anomaly since. Sheds
+are too frequent to freeze individually; a *spike* (``shed_spike``
+sheds within ``shed_window_s``) freezes once.
+
+Export surfaces: ``Engine.debug_bundle()`` → ``GET /v1/debug/bundle`` and
+``cilium-tpu debug-bundle``. Everything here is lock-leaf and never-raise:
+the recorder can observe a dying pipeline without joining it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from cilium_tpu.runtime.metrics import Metrics
+from cilium_tpu.utils import constants as C
+
+log = logging.getLogger("cilium_tpu.blackbox")
+
+#: event kinds that freeze the recorder on sight. Breaker events are NOT
+#: here: they arrive as kind="breaker" with old/new attrs and freeze only
+#: on the new=="open" transition (record_event's special case) — a close
+#: or half-open probe is recovery, not an anomaly. Sheds freeze only as a
+#: spike (see module docstring). Watchdog restarts and hard-fails both
+#: arrive as kind="watchdog" (the action attr distinguishes them).
+FREEZE_KINDS = frozenset(("watchdog", "parity-mismatch"))
+
+
+class FlightRecorder:
+    def __init__(self, *, capacity: int = 256, verdict_batches: int = 64,
+                 stats_snapshots: int = 8,
+                 shed_spike: int = 64, shed_window_s: float = 5.0,
+                 span_tail: int = 128,
+                 metrics: Optional[Metrics] = None,
+                 tracer=None):
+        if capacity < 1 or verdict_batches < 1:
+            raise ValueError("capacity and verdict_batches must be >= 1")
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        self._events: Deque[Dict] = deque(maxlen=capacity)
+        self._verdicts: Deque[Dict] = deque(maxlen=verdict_batches)
+        self._stats: Deque[Dict] = deque(maxlen=stats_snapshots)
+        self._span_tail = span_tail
+        self._shed_spike = shed_spike
+        self._shed_window_s = shed_window_s
+        self._shed_times: Deque[float] = deque(maxlen=max(1, shed_spike))
+        self._frozen: Optional[Dict] = None
+        self.freezes_total = 0
+        self.events_total = 0
+
+    # -- feed side (never raises) --------------------------------------------
+    def record_event(self, kind: str, **attrs) -> None:
+        """One guard/regen/audit event into the ring. Auto-freezes on
+        anomalous kinds; shed events freeze only as a spike."""
+        try:
+            evt = {"t": time.time(), "mono": time.monotonic(),
+                   "kind": kind, **attrs}
+            with self._lock:
+                self._events.append(evt)
+                self.events_total += 1
+            if kind == "shed":
+                self._note_shed(evt["mono"])
+                return
+            if kind in FREEZE_KINDS or \
+                    (kind == "breaker" and attrs.get("new") == "open"):
+                self.freeze(f"{kind}:{attrs.get('reason', attrs.get('new', ''))}"
+                            .rstrip(":"), detail=attrs)
+        except Exception:   # noqa: BLE001 — the recorder must never bite
+            log.exception("flight recorder event failed")
+
+    def _note_shed(self, mono: float) -> None:
+        self._shed_times.append(mono)
+        if len(self._shed_times) == self._shed_times.maxlen \
+                and mono - self._shed_times[0] <= self._shed_window_s:
+            self.freeze("shed-spike", detail={
+                "sheds": len(self._shed_times),
+                "window_s": round(mono - self._shed_times[0], 3)})
+            self._shed_times.clear()
+
+    def record_verdicts(self, out: Dict[str, np.ndarray], n_valid: int,
+                        now: int) -> None:
+        """Per-finalized-batch verdict summary (vectorized; no row copies).
+        Cheap enough to run always-on beside the metrics fold."""
+        try:
+            allow = np.asarray(out["allow"])
+            reasons = np.asarray(out["reason"])
+            dropped = reasons[~allow & (reasons != 0)]
+            top: Dict[str, int] = {}
+            if dropped.size:
+                vals, counts = np.unique(dropped, return_counts=True)
+                order = np.argsort(counts)[::-1][:4]
+                for v, c in zip(vals[order], counts[order]):
+                    try:
+                        name = C.DropReason(int(v)).name
+                    except ValueError:
+                        name = str(int(v))
+                    top[name] = int(c)
+            summary = {"t": time.time(), "now": now, "rows": n_valid,
+                       "allowed": int(allow.sum()),
+                       "dropped": int(dropped.size), "top_reasons": top}
+            with self._lock:
+                self._verdicts.append(summary)
+        except Exception:   # noqa: BLE001
+            log.exception("flight recorder verdict summary failed")
+
+    def note_stats(self, doc: Dict) -> None:
+        """Periodic stats snapshot (pipeline/feeder/health); the engine's
+        observability flush feeds this so a frozen bundle carries the state
+        trajectory, not just the instant of the anomaly."""
+        try:
+            with self._lock:
+                self._stats.append({"t": time.time(), **doc})
+        except Exception:   # noqa: BLE001
+            log.exception("flight recorder stats snapshot failed")
+
+    # -- freeze / export ------------------------------------------------------
+    def freeze(self, reason: str, detail: Optional[Dict] = None) -> Dict:
+        """Freeze the current tail into the debug bundle. First anomaly
+        wins (its bundle is the root-cause record); later freezes only
+        count. Returns the live-built bundle either way."""
+        bundle = self._build(reason, detail)
+        with self._lock:
+            self.freezes_total += 1
+            first = self._frozen is None
+            if first:
+                self._frozen = bundle
+        self.metrics.inc_counter(
+            f'blackbox_freezes_total{{reason="{reason.split(":", 1)[0]}"}}')
+        if first:
+            log.warning("flight recorder FROZE a debug bundle: %s", reason)
+        return bundle
+
+    def _build(self, reason: str, detail: Optional[Dict]) -> Dict:
+        with self._lock:
+            events = list(self._events)
+            verdicts = list(self._verdicts)
+            stats = list(self._stats)
+        spans: List[Dict] = []
+        span_stats: Dict = {}
+        if self.tracer is not None:
+            try:
+                spans = self.tracer.spans(limit=self._span_tail)
+                span_stats = self.tracer.stats()
+            except Exception:   # noqa: BLE001
+                pass
+        return {
+            "reason": reason,
+            "frozen_at": time.time(),
+            "detail": detail or {},
+            "events": events,
+            "verdict_summaries": verdicts,
+            "stats_snapshots": stats,
+            "spans": spans,
+            "trace_stats": span_stats,
+        }
+
+    def bundle(self, extra: Optional[Dict] = None,
+               clear: bool = False) -> Dict:
+        """The export surface: the frozen bundle when an anomaly captured
+        one, else a live snapshot. ``extra`` (live engine state gathered by
+        the caller) is attached at fetch time — freeze itself never calls
+        back into the pipeline, so it can run from any thread without
+        lock-order concerns. ``clear=True`` re-arms the recorder after the
+        fetch."""
+        with self._lock:
+            frozen = self._frozen
+        doc = dict(frozen) if frozen is not None \
+            else self._build("live-snapshot", None)
+        doc["frozen"] = frozen is not None
+        doc["freezes_total"] = self.freezes_total
+        if extra:
+            doc["engine"] = extra
+        if clear:
+            self.clear()
+        return doc
+
+    def clear(self) -> None:
+        with self._lock:
+            self._frozen = None
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "events_in_ring": len(self._events),
+                "events_total": self.events_total,
+                "verdict_summaries": len(self._verdicts),
+                "freezes_total": self.freezes_total,
+                "frozen": self._frozen is not None,
+                "frozen_reason": self._frozen["reason"]
+                if self._frozen else None,
+            }

@@ -166,7 +166,7 @@ class Ticket:
     the serial classify path)."""
 
     __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono", "trace_id",
-                 "deadline_mono", "_event", "_out", "_exc")
+                 "deadline_mono", "ingest_mono", "_event", "_out", "_exc")
 
     def __init__(self, n_rows: int, n_valid: int):
         self.seq = -1                      # assigned at admission
@@ -174,6 +174,11 @@ class Ticket:
         self.n_valid = n_valid
         self.trace_id = None               # observe/trace sampling decision
         self.submitted_mono = time.monotonic()
+        # when the rows actually entered the host (the shim feeder's
+        # harvest stamp, monotonic seconds) — what true ingest→verdict
+        # latency is measured from; None for producers that submit the
+        # instant they build the batch (submitted_mono is then the truth)
+        self.ingest_mono: Optional[float] = None
         self.deadline_mono: Optional[float] = None   # shed-after fence
         self._event = threading.Event()
         self._out: Optional[Dict[str, np.ndarray]] = None
@@ -309,7 +314,8 @@ class Pipeline:
                  n_shards: int = 1,
                  shard_fn: Optional[Callable] = None,
                  shard_headroom: int = 4,
-                 shard_rev_fn: Optional[Callable[[], int]] = None):
+                 shard_rev_fn: Optional[Callable[[], int]] = None,
+                 event_sink: Optional[Callable] = None):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
@@ -369,6 +375,12 @@ class Pipeline:
             for s in range(n_shards)] if n_shards > 1 else []
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else TRACER
+        # guard-event sink (the flight recorder, observe/blackbox.py):
+        # breaker transitions, watchdog restarts and sheds are narrated to
+        # it so an anomaly freezes with its lead-up intact. Fired outside
+        # the pipeline lock, exceptions swallowed — a broken recorder can
+        # never take the worker down
+        self._event_sink = event_sink
         self._max_bucket = max_bucket
         self._min_bucket = min_bucket
         self._queue_max = queue_batches
@@ -465,7 +477,8 @@ class Pipeline:
     def submit(self, batch: Dict[str, np.ndarray],
                now: Optional[int] = None,
                timeout: Optional[float] = None,
-               deadline_ms: Optional[float] = None) -> Ticket:
+               deadline_ms: Optional[float] = None,
+               ingest_mono: Optional[float] = None) -> Ticket:
         """Admit one batch (records layout, ``valid``-masked). Returns a
         :class:`Ticket` immediately; with ``admission="drop"`` (or a blocked
         admission that times out) the ticket comes back already rejected
@@ -498,6 +511,10 @@ class Pipeline:
                 "circuit breaker open after consecutive dispatch failures; "
                 f"retry in {self.breaker.stats().get('retry_in_s', 0.0)}s")
         ticket = Ticket(n_rows=int(valid.shape[0]), n_valid=n_valid)
+        # the harvest stamp rides the ticket so verdict-apply can compute
+        # TRUE ingest→verdict latency (queue wait alone measures only the
+        # pipeline's share of the 30-60x compute-vs-end-to-end gap)
+        ticket.ingest_mono = ingest_mono
         dl = self._default_deadline_s if deadline_ms is None \
             else (deadline_ms / 1e3 if deadline_ms > 0 else None)
         if dl is not None:
@@ -753,8 +770,18 @@ class Pipeline:
         self.unavailable_total += 1
         self.metrics.inc_counter("pipeline_unavailable_total")
 
-    def _on_breaker_transition(self, _old: str, _new: str) -> None:
+    def _emit(self, kind: str, **attrs) -> None:
+        sink = self._event_sink
+        if sink is None:
+            return
+        try:
+            sink(kind, **attrs)
+        except Exception:   # noqa: BLE001 — the sink is observability-only
+            log.exception("pipeline event sink failed for %r", kind)
+
+    def _on_breaker_transition(self, old: str, new: str) -> None:
         self._set_state_gauge()
+        self._emit("breaker", old=old, new=new)
 
     def _set_state_gauge(self) -> None:
         self.metrics.set_gauge("pipeline_state",
@@ -912,6 +939,9 @@ class Pipeline:
                           action="hard-fail" if hard_fail else "restart",
                           reason=reason, restarts=restarts,
                           rejected=len(wedged))
+        self._emit("watchdog",
+                   action="hard-fail" if hard_fail else "restart",
+                   reason=reason, restarts=restarts, rejected=len(wedged))
         log.warning("pipeline %s (restart %d/%d): %s; rejecting %d wedged "
                     "ticket(s)",
                     "HARD-FAILED" if hard_fail else "worker restarting",
@@ -979,6 +1009,7 @@ class Pipeline:
                            ticket.submitted_mono,
                            time.monotonic() - ticket.submitted_mono,
                            {"reason": reason})
+        self._emit("shed", reason=reason, seq=ticket.seq)
         if exc is None:
             exc = PipelineDeadlineExceeded(
                 f"deadline exceeded before {reason} (seq={ticket.seq}, "

@@ -24,6 +24,12 @@ Routes (all under /v1):
                               cumulative totals (the hubble metrics analog)
   GET  /v1/trace?limit=N&name=S     sampled span ring + per-stage summary
                               (observe/trace.py; empty when tracing is off)
+  GET  /v1/debug/bundle?clear=1     flight-recorder debug bundle
+                              (observe/blackbox.py): the frozen anomaly
+                              bundle when one exists (parity mismatch,
+                              breaker open, watchdog restart, shed spike),
+                              else a live snapshot; ?clear=1 re-arms the
+                              recorder after the fetch
   GET  /v1/fqdn/cache         learned DNS names
   GET  /v1/metrics            Prometheus text (text/plain), incl. flow
                               metrics totals
@@ -173,6 +179,9 @@ def status_doc(engine: "Engine") -> Dict:
         # None until the autotune controller has run against a pipeline
         "autotune": engine.autotune_status(),
         "trace": engine.tracer.stats(),
+        # verdict provenance: parity-audit counters + flight-recorder state
+        "audit": engine.auditor.stats(),
+        "blackbox": engine.blackbox.stats(),
     }
 
 
@@ -459,6 +468,9 @@ class _Handler(BaseHTTPRequestHandler):
                         int(q.get("last", 0))),
                     "totals": eng.flowmetrics.totals(),
                 })
+            if path == "/v1/debug/bundle":
+                return self._send_json(200, eng.debug_bundle(
+                    clear=q.get("clear") in ("1", "true")))
             if path == "/v1/trace":
                 return self._send_json(200, {
                     "stats": eng.tracer.stats(),

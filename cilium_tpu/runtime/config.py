@@ -97,6 +97,21 @@ class DaemonConfig:
     autotune_queue_wait_p99_ms: float = 10.0   # p99 queue-wait budget
     autotune_hysteresis: int = 3     # consecutive intervals before a step
     autotune_step_factor: float = 1.5  # capped multiplicative step
+    # --- observe/: shadow-oracle parity audit (observe/audit.py) ---
+    audit_enabled: bool = False      # background parity-audit controller
+    audit_sample_rate: float = 0.015625  # 1/64 of finalized batches captured
+    audit_pool_batches: int = 8      # bounded capture pool (overflow=skipped)
+    audit_max_rows: int = 512        # rows captured per sampled batch
+    audit_interval_s: float = 1.0    # parity-audit controller interval
+    # --- observe/: flight recorder (observe/blackbox.py; always on) ---
+    blackbox_events: int = 256       # guard/regen/audit event ring
+    blackbox_verdicts: int = 64      # last-N per-batch verdict summaries
+    blackbox_shed_spike: int = 64    # sheds within the window that freeze
+    blackbox_shed_window_s: float = 5.0
+    # --- end-to-end latency SLO (shim harvest → verdict apply) ---
+    # burn threshold for ingest_e2e_slo_burn_total (+{shard=...}); 0 keeps
+    # the e2e histograms exporting but disables burn counting
+    slo_e2e_ms: float = 0.0
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:
@@ -157,6 +172,22 @@ class DaemonConfig:
             raise ValueError("autotune_queue_wait_p99_ms must be > 0")
         if self.autotune_interval_s <= 0:
             raise ValueError("autotune_interval_s must be > 0")
+        if not 0.0 <= self.audit_sample_rate <= 1.0:
+            raise ValueError("audit_sample_rate must be in [0, 1]")
+        if self.audit_pool_batches < 1 or self.audit_max_rows < 1:
+            raise ValueError(
+                "audit_pool_batches and audit_max_rows must be >= 1")
+        if self.audit_interval_s <= 0:
+            raise ValueError("audit_interval_s must be > 0")
+        if self.blackbox_events < 1 or self.blackbox_verdicts < 1 \
+                or self.blackbox_shed_spike < 1:
+            raise ValueError("blackbox_events, blackbox_verdicts and "
+                             "blackbox_shed_spike must be >= 1")
+        if self.blackbox_shed_window_s <= 0:
+            raise ValueError("blackbox_shed_window_s must be > 0")
+        if self.slo_e2e_ms < 0:
+            raise ValueError("slo_e2e_ms must be >= 0 (0 = no burn "
+                             "counting)")
 
     # -- sources -------------------------------------------------------------
     @classmethod

@@ -719,10 +719,9 @@ class FakeDatapath(DatapathBackend):
         must not retarget an in-flight batch)."""
         from oracle import Oracle
         if self._oracle is None or self._oracle_snap is not snap:
-            oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
-                            snap.ipcache,
-                            lb=snap.lb if snap.lb.n_frontends else None)
-            oracle.ct = self._ct_table   # CT persists across snapshot swaps
+            # CT persists across snapshot swaps: the table, not the oracle,
+            # owns connection state
+            oracle = Oracle.for_snapshot(snap, ct=self._ct_table)
             self._oracle, self._oracle_snap = oracle, snap
         return self._oracle
 

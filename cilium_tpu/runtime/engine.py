@@ -33,6 +33,8 @@ from cilium_tpu.model.ipcache import IPCache
 from cilium_tpu.model.labels import Labels
 from cilium_tpu.model.rules import parse_rules
 from cilium_tpu.model.services import ServiceRegistry
+from cilium_tpu.observe.audit import ShadowAuditor
+from cilium_tpu.observe.blackbox import FlightRecorder
 from cilium_tpu.observe.flowmetrics import FlowMetrics
 from cilium_tpu.observe.trace import TRACER
 from cilium_tpu.policy.repository import PolicyContext, Repository
@@ -98,6 +100,26 @@ class Engine:
             window_s=self.config.flowmetrics_window_s,
             n_windows=self.config.flowmetrics_windows,
             top_k=self.config.flowmetrics_top_k)
+        # verdict provenance (observe/audit.py + observe/blackbox.py): the
+        # flight recorder is always on (bounded rings, freeze on anomaly);
+        # the shadow auditor is constructed unconditionally but samples
+        # nothing until audit_enabled arms it (tests/chaos re-arm via
+        # auditor.configure) — its capture path costs one attribute read
+        # per finalized batch when disarmed
+        self.blackbox = FlightRecorder(
+            capacity=self.config.blackbox_events,
+            verdict_batches=self.config.blackbox_verdicts,
+            shed_spike=self.config.blackbox_shed_spike,
+            shed_window_s=self.config.blackbox_shed_window_s,
+            metrics=self.metrics, tracer=TRACER)
+        self.auditor = ShadowAuditor(
+            sample_rate=self.config.audit_sample_rate
+            if self.config.audit_enabled else 0.0,
+            pool_batches=self.config.audit_pool_batches,
+            max_rows=self.config.audit_max_rows,
+            n_shards=getattr(self.datapath, "pipeline_shards", 1),
+            metrics=self.metrics,
+            on_mismatch=self._on_parity_mismatch)
         self.controllers = ControllerManager()
 
         self._lock = threading.RLock()
@@ -356,6 +378,11 @@ class Engine:
         self.metrics.set_gauge("policy_image_bytes", snap.nbytes)
         self.metrics.set_gauge("engine_degraded", 0)
         self.metrics.set_gauge("regen_consecutive_failures", 0)
+        # flight recorder: the revision trail is what makes a frozen bundle
+        # attributable ("which policy world were these verdicts from")
+        self.blackbox.record_event("regen", revision=snap.revision,
+                                   incremental=patch is not None
+                                   and not full_build)
         return compiled
 
     @property
@@ -378,12 +405,65 @@ class Engine:
                 self.metrics.span("classify").timer():
             out, counters = self.datapath.classify(
                 active.tensors, active.snapshot, batch, now)
-        self.metrics.add_batch(counters,
-                               int(np.asarray(batch["valid"]).sum()))
+        n_valid = int(np.asarray(batch["valid"]).sum())
+        self.metrics.add_batch(counters, n_valid)
         self.flowlog.append_batch(batch, out, now,
                                   active.snapshot.ep_ids)
         self.flowmetrics.add_batch(batch, out, now)
+        self._observe_batch(batch, out, active.snapshot, now, n_valid)
         return out
+
+    # -- verdict provenance (observe/audit.py + observe/blackbox.py) ------------
+    def _observe_batch(self, batch, out, snap, now: int, n_valid: int,
+                       steered: bool = False) -> None:
+        """Per-finalized-batch provenance hooks: flight-recorder verdict
+        summary (always on) + shadow-audit counter-sampled capture. Both
+        are internally never-raise; the serving path cannot be taken down
+        by its own observers."""
+        self.blackbox.record_verdicts(out, n_valid, now)
+        self.auditor.maybe_capture(batch, out, snap, now, steered=steered)
+
+    def _on_parity_mismatch(self, detail: Dict) -> None:
+        """Auditor mismatch sink: narrate to the flight recorder (which
+        freezes a debug bundle on this kind — the offending rows + revision
+        ride in the detail) and pin the degraded flag health() folds in."""
+        self.metrics.set_gauge("parity_audit_degraded", 1)
+        self.blackbox.record_event("parity-mismatch", **detail)
+
+    def _pipeline_event(self, kind: str, **attrs) -> None:
+        """Pipeline guard-event sink → flight recorder (breaker
+        transitions, watchdog restarts, sheds)."""
+        self.blackbox.record_event(kind, **attrs)
+
+    def audit_step(self, budget: Optional[int] = None) -> Optional[Dict]:
+        """One parity-audit replay sweep (the ``parity-audit`` controller
+        body; also directly callable from tests/drills)."""
+        return self.auditor.step(budget=budget)
+
+    def debug_bundle(self, clear: bool = False) -> Dict:
+        """The flight-recorder export: the frozen anomaly bundle when one
+        exists (parity mismatch, breaker open, watchdog restart, shed
+        spike), else a live snapshot — enriched with the engine state an
+        operator needs to replay it (health, pipeline/feeder stats, audit
+        counters + mismatch details, active revision). ``clear=True`` is
+        the operator re-arm: the recorder unfreezes AND the auditor's
+        mismatch state resets, so health() returns to OK and the next
+        divergence degrades/freezes afresh."""
+        active = self._active
+        extra = {
+            "health": self.health(),
+            "active_revision": active.revision if active else None,
+            "pipeline": self.pipeline_stats(),
+            "feeder": self.feeder_stats(),
+            "audit": self.auditor.stats(),
+            "audit_mismatches": list(self.auditor.mismatches),
+            "blackbox": self.blackbox.stats(),
+        }
+        doc = self.blackbox.bundle(extra=extra, clear=clear)
+        if clear:
+            self.auditor.rearm()
+            self.metrics.set_gauge("parity_audit_degraded", 0)
+        return doc
 
     # -- pipelined ingestion (pipeline/scheduler.py) ----------------------------
     def start_pipeline(self):
@@ -429,22 +509,28 @@ class Engine:
                     # post-DNAT steer hash)
                     shard_rev_fn=(lambda: self._active.revision
                                   if self._active is not None else -1)
-                    if shards > 1 else None)
+                    if shards > 1 else None,
+                    event_sink=self._pipeline_event)
             return self._pipeline
 
     def submit(self, batch: Dict[str, np.ndarray],
                now: Optional[int] = None,
-               deadline_ms: Optional[float] = None):
+               deadline_ms: Optional[float] = None,
+               ingest_mono: Optional[float] = None):
         """Admit one batch into the ingestion pipeline; returns a Ticket
         whose ``result()`` is bit-identical to what :meth:`classify` would
         return for the same batch in the same order. ``deadline_ms``
         bounds staleness (default ``config.pipeline_deadline_ms``); a
         submission the worker cannot serve in time is shed with
-        ``PipelineDeadlineExceeded``. Raises ``PipelineUnavailable`` while
-        the dispatch circuit breaker is open or after the pipeline
-        hard-failed (watchdog restart budget exhausted)."""
+        ``PipelineDeadlineExceeded``. ``ingest_mono`` (monotonic seconds)
+        is the producer's harvest stamp — it rides the ticket so
+        verdict-apply can compute true ingest→verdict latency. Raises
+        ``PipelineUnavailable`` while the dispatch circuit breaker is open
+        or after the pipeline hard-failed (watchdog restart budget
+        exhausted)."""
         return self.start_pipeline().submit(batch, now=now,
-                                            deadline_ms=deadline_ms)
+                                            deadline_ms=deadline_ms,
+                                            ingest_mono=ingest_mono)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until every pipeline submission so far has resolved."""
@@ -522,11 +608,16 @@ class Engine:
 
         def finalize():
             out, counters = fin()
-            self.metrics.add_batch(counters,
-                                   int(np.asarray(batch["valid"]).sum()))
+            n_valid = int(np.asarray(batch["valid"]).sum())
+            self.metrics.add_batch(counters, n_valid)
             self.flowlog.append_batch(batch, out, now,
                                       active.snapshot.ep_ids)
             self.flowmetrics.add_batch(batch, out, now)
+            # the finalize capture hook: batch/out are still the live
+            # (un-recycled) staging views here — the audit copy happens
+            # before the scheduler recycles the buffer
+            self._observe_batch(batch, out, active.snapshot, now, n_valid,
+                                steered=self._pipeline_sharded)
             return out
         return finalize
 
@@ -556,6 +647,7 @@ class Engine:
                 pool_batches=cfg.ingest_pool_batches,
                 poll_budget=cfg.ingest_poll_budget,
                 idle_sleep_s=cfg.ingest_idle_sleep_s,
+                slo_ms=cfg.slo_e2e_ms,
                 # sharded mesh: harvest computes the flow-shard hash during
                 # ep-slot mapping (vectorized, shares flow_shard_of) so the
                 # staging ring's flush-time scatter is a copy, not a
@@ -610,6 +702,14 @@ class Engine:
             self.controllers.update(
                 "pipeline-autotune", self._autotune_step,
                 interval=self.config.autotune_interval_s)
+        if self.config.audit_enabled:
+            # the shadow-oracle replay loop (observe/audit.py): supervised
+            # like every controller — a crashing/wedged replay backs off
+            # and the bounded capture pool degrades to `skipped`, never to
+            # a stalled serving path
+            self.controllers.update(
+                "parity-audit", lambda: self.audit_step(budget=64),
+                interval=self.config.audit_interval_s)
 
     def _autotune_step(self):
         """One autotune control interval (controller body). No-ops until
@@ -670,6 +770,20 @@ class Engine:
                 "repo_revision": self.repo.revision,
             }
             pl = self._pipeline
+        aud = self.auditor
+        if not aud.healthy:
+            # a parity mismatch means verdicts diverged from the semantic
+            # oracle under a live revision: serving still answers (the
+            # sampled mismatch does not prove every verdict wrong), but
+            # the daemon is provably not bit-identical — DEGRADED until an
+            # operator pulls the debug bundle and re-arms
+            doc["audit"] = {
+                "mismatched_rows": aud.mismatched_rows,
+                "checked_rows": aud.checked_rows,
+                "last_mismatch_revision": aud.last_mismatch_revision,
+            }
+            if doc["state"] == C.HEALTH_OK:
+                doc["state"] = C.HEALTH_DEGRADED
         if pl is not None:
             # outside the engine lock: pipeline stats take the pipeline
             # lock and must stay a leaf in the lock order; one snapshot
@@ -775,13 +889,29 @@ class Engine:
                             name = f"datapath_{k}_total"
                         self.metrics.inc_counter(name, d)
                         self._pack_stats_seen[k] = v
+        # feeder liveness/occupancy as first-class gauge families (the
+        # monotone feeder_*_total counters are already incremented live by
+        # the feeder itself; these are the fields that existed only in
+        # feeder_stats() — a scrape must see them without the status API)
+        fd = self.feeder_stats()
+        if fd is not None:
+            self.metrics.set_gauge("feeder_alive", 1 if fd["alive"] else 0)
+            self.metrics.set_gauge("feeder_pool_free", fd["pool_free"])
+            self.metrics.set_gauge("feeder_pending", fd["pending"])
         return (self.metrics.render_prometheus()
                 + self.flowmetrics.render_prometheus())
 
     def flush_observability(self) -> None:
         """Flush the flow-log sink and write the Prometheus text file (the
         hubble-export + node-exporter-textfile analog). Also callable
-        directly for synchronous export."""
+        directly for synchronous export. Each flush also notes a stats
+        snapshot into the flight recorder, so a later frozen bundle
+        carries the state trajectory leading up to its anomaly."""
+        self.blackbox.note_stats({
+            "pipeline": self.pipeline_stats(),
+            "feeder": self.feeder_stats(),
+            "audit": self.auditor.stats(),
+        })
         if self.config.flowlog_path:
             self.flowlog.flush_sink()
         if self.config.metrics_path:

@@ -17,6 +17,9 @@ point                   fires in
                         reject the batch; ``hang`` stalls for the watchdog)
 ``datapath.transfer``   host→device transfer enqueue inside
                         ``JITDatapath.classify_async``
+``audit.corrupt``       shadow-audit capture (``observe/audit.py``): a trip
+                        flips the captured allow bits so the parity auditor
+                        must detect the divergence
 ======================  =====================================================
 
 Each point can be **armed** with one spec:
@@ -79,6 +82,11 @@ POINTS: Dict[str, str] = {
     "datapath.transfer": "host→device transfer enqueue in "
                          "JITDatapath.classify_async (serial classify and "
                          "the pipeline both route through it)",
+    "audit.corrupt": "shadow-audit capture in observe/audit.py: an armed "
+                     "trip flips the CAPTURED allow bits (the copy, never "
+                     "the live verdicts) — simulates a datapath parity bug "
+                     "so chaos drills prove the auditor detects, health "
+                     "degrades, and a flight-recorder bundle freezes",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

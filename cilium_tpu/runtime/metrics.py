@@ -81,20 +81,44 @@ class Histogram:
     def quantile(self, q: float) -> float:
         """Approximate quantile from the bucket counts (linear within the
         winning bucket). For observations past the last finite boundary the
-        boundary itself is returned — a histogram cannot do better."""
-        buckets, counts, _total, _count = self.snapshot()
+        boundary itself is returned — a histogram cannot do better. An
+        empty histogram reads 0.0 — this is a *display* surface (status
+        docs, bench artifacts), where "no observations yet" rendering as 0
+        is the established convention; interval/delta consumers must use
+        :func:`quantile_from` and test for :data:`EMPTY_QUANTILE`."""
+        buckets, counts, _total, count = self.snapshot()
+        if count == 0:
+            return 0.0
         return quantile_from(buckets, counts, q)
+
+
+#: the empty-window sentinel :func:`quantile_from` returns when the count
+#: vector sums to zero. NaN, deliberately: every arithmetic comparison
+#: against it is False, so a consumer that forgets to check cannot mistake
+#: an idle interval for a zero-latency one (the pre-fix 0.0 return made a
+#: scrape gap look like "queue wait collapsed" to the autotuner and would
+#: read as "SLO met" to burn-rate math). Test with ``math.isnan`` /
+#: :func:`quantile_is_empty`.
+EMPTY_QUANTILE = float("nan")
+
+
+def quantile_is_empty(value: float) -> bool:
+    """True iff ``value`` is the :data:`EMPTY_QUANTILE` sentinel."""
+    return value != value            # NaN is the only float unequal to itself
 
 
 def quantile_from(buckets: Sequence[float], counts: Sequence[int],
                   q: float) -> float:
     """Quantile over a (buckets, counts) pair — shared by
     ``Histogram.quantile`` and consumers working on *delta* counts (the
-    observe autotuner diffs successive snapshots so each control interval
-    is judged on its own distribution, not the process lifetime's)."""
+    observe autotuner and the SLO burn math diff successive snapshots so
+    each control interval is judged on its own distribution, not the
+    process lifetime's). A zero-count window — two scrapes with no
+    observations in between — returns :data:`EMPTY_QUANTILE` (NaN), never
+    a fabricated 0.0."""
     count = sum(counts)
     if count == 0:
-        return 0.0
+        return EMPTY_QUANTILE
     target = q * count
     acc = 0
     lo = 0.0
@@ -214,16 +238,27 @@ class Metrics:
                 lines.append(f"ciliumtpu_{name}_seconds_count {s.count}")
                 lines.append(f"ciliumtpu_{name}_seconds_sum {s.total_s:.6f}")
                 lines.append(f"ciliumtpu_{name}_seconds_max {s.max_s:.6f}")
+            # histograms may carry a label set in the name too (the
+            # per-shard ingest e2e families, ``..._seconds{shard="3"}``):
+            # one TYPE line per base metric, labels merged into each
+            # bucket's le label and suffixed onto _sum/_count
+            htyped = set()
             for name, h in sorted(self.histograms.items()):
                 buckets, counts, total, count = h.snapshot()
-                lines.append(f"# TYPE ciliumtpu_{name} histogram")
+                base, _, labels = name.partition("{")
+                labels = labels.rstrip("}")
+                lbl_prefix = f"{labels}," if labels else ""
+                lbl_suffix = f"{{{labels}}}" if labels else ""
+                if base not in htyped:
+                    lines.append(f"# TYPE ciliumtpu_{base} histogram")
+                    htyped.add(base)
                 acc = 0
                 for le, n in zip(buckets, counts):
                     acc += n
-                    lines.append(
-                        f'ciliumtpu_{name}_bucket{{le="{le}"}} {acc}')
-                lines.append(
-                    f'ciliumtpu_{name}_bucket{{le="+Inf"}} {count}')
-                lines.append(f"ciliumtpu_{name}_sum {total:.6f}")
-                lines.append(f"ciliumtpu_{name}_count {count}")
+                    lines.append(f'ciliumtpu_{base}_bucket'
+                                 f'{{{lbl_prefix}le="{le}"}} {acc}')
+                lines.append(f'ciliumtpu_{base}_bucket'
+                             f'{{{lbl_prefix}le="+Inf"}} {count}')
+                lines.append(f"ciliumtpu_{base}_sum{lbl_suffix} {total:.6f}")
+                lines.append(f"ciliumtpu_{base}_count{lbl_suffix} {count}")
         return "\n".join(lines) + "\n"

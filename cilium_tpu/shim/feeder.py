@@ -98,6 +98,7 @@ class ShimFeeder:
                  poll_budget: int = 256,
                  idle_sleep_s: float = 0.0005,
                  n_shards: int = 1,
+                 slo_ms: float = 0.0,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  name: str = "feeder"):
@@ -117,6 +118,10 @@ class ShimFeeder:
         self._idle_sleep_s = idle_sleep_s
         self._n_shards = n_shards
         self._name = name
+        # end-to-end latency SLO: harvest stamp → verdict apply, the TRUE
+        # ingest→verdict number (queue wait + staging + dispatch + device +
+        # FIFO head-of-line wait). slo_ms > 0 arms the burn counters.
+        self._slo_s = slo_ms / 1e3 if slo_ms > 0 else 0.0
 
         self._free: deque = deque(shim.make_poll_buffer()
                                   for _ in range(pool_batches))
@@ -148,6 +153,7 @@ class ShimFeeder:
         self.rejected_batches = 0          # applied fail-closed
         self.harvest_faults = 0
         self.errors = 0                    # unexpected step failures
+        self.slo_burns = 0                 # applied batches past the SLO
         self._submit_rejects = 0           # log-throttle counter
 
     # -- lifecycle -----------------------------------------------------------
@@ -179,6 +185,7 @@ class ShimFeeder:
 
     def stats(self) -> Dict:
         t = self._thread
+        e2e = self.metrics.histograms.get("ingest_e2e_latency_seconds")
         return {
             "harvested_batches": self.harvested_batches,
             "harvested_records": self.harvested_records,
@@ -189,6 +196,10 @@ class ShimFeeder:
             "alive": bool(t is not None and t.is_alive()),
             "pending": len(self._pending),
             "pool_free": len(self._free),
+            "slo_ms": round(self._slo_s * 1e3, 3),
+            "slo_burns": self.slo_burns,
+            "e2e_p50_ms": round(e2e.quantile(0.5) * 1e3, 3) if e2e else 0.0,
+            "e2e_p99_ms": round(e2e.quantile(0.99) * 1e3, 3) if e2e else 0.0,
         }
 
     # -- harvest loop ---------------------------------------------------------
@@ -256,7 +267,9 @@ class ShimFeeder:
             self.harvested_records += n_valid
             self.metrics.inc_counter("feeder_harvest_records_total",
                                      n_valid)
-            ticket = self.engine.submit(b)
+            # the harvest stamp rides the ticket (true ingest→verdict
+            # latency; monotonic — same clock as now_us above)
+            ticket = self.engine.submit(b, ingest_mono=now_us / 1e6)
         except Exception as e:   # noqa: BLE001 — unavailable/closed/
             # regen-storm engine.active/... : the shim already holds this
             # batch's FrameRefs, so a verdict MUST be consumed for it —
@@ -270,7 +283,7 @@ class ShimFeeder:
                 log.warning("feeder submit rejected (%d), queueing "
                             "fail-closed drop verdicts: %s",
                             self._submit_rejects, e)
-        self._pending.append((ticket, buf))
+        self._pending.append((ticket, buf, now_us / 1e6))
         self.metrics.set_gauge("feeder_pending", len(self._pending))
         return True
 
@@ -334,7 +347,7 @@ class ShimFeeder:
         ``block`` the head ticket is awaited up to ``block_timeout``."""
         did = False
         while self._pending:
-            ticket, buf = self._pending[0]
+            ticket, buf, ingest_mono = self._pending[0]
             if ticket is not None and not ticket.done():
                 if not block:
                     break
@@ -346,12 +359,13 @@ class ShimFeeder:
                     pass
                 block = False        # at most one blocking wait per call
             self._pending.popleft()
-            self._apply_one(ticket, buf)
+            self._apply_one(ticket, buf, ingest_mono=ingest_mono)
             did = True
         self.metrics.set_gauge("feeder_pending", len(self._pending))
         return did
 
-    def _apply_one(self, ticket, buf, recycle: bool = True) -> None:
+    def _apply_one(self, ticket, buf, recycle: bool = True,
+                   ingest_mono: Optional[float] = None) -> None:
         """Apply one batch's verdicts (``ticket is None``: the rejected-
         at-submit sentinel — all-drop, fail closed). ``recycle=False``
         sheds the buffer instead of pooling it — for tickets that did NOT
@@ -370,6 +384,10 @@ class ShimFeeder:
         except Exception:   # noqa: BLE001
             log.exception("apply_verdicts failed; frame/verdict FIFO may "
                           "be desynced")
+        if not rejected and ingest_mono is not None:
+            # verdict-apply is the END of the serving path for this batch:
+            # harvest stamp → here is the true ingest→verdict latency
+            self._observe_e2e(time.monotonic() - ingest_mono, buf)
         if rejected:
             self.rejected_batches += 1
             self.metrics.inc_counter("feeder_rejected_batches_total")
@@ -377,6 +395,48 @@ class ShimFeeder:
         self.metrics.inc_counter("feeder_applied_batches_total")
         if recycle:
             self._free.append(buf)
+
+    def _observe_e2e(self, lat_s: float, buf: Dict[str, np.ndarray]) -> None:
+        """One applied batch's ingest→verdict latency into the e2e SLO
+        surface: the ``ingest_e2e_latency_seconds`` histogram (plus a
+        per-shard labeled family when the batch pre-binned onto a mesh) and
+        the SLO burn counters when a threshold is armed.
+
+        Attribution is BATCH-granular: every row in the batch experienced
+        the same harvest→apply latency, so the batch's latency is observed
+        once into each shard family that had valid rows (the latency shard
+        N's rows truly saw). Under uniformly mixed harvest batches the
+        per-shard series therefore move together; they become differential
+        exactly when the mesh degrades asymmetrically — only the batches
+        carrying the slow shard's rows stall, and that shard's family (and
+        burn counter) pulls away from the rest. The unlabeled family/burn
+        counts each batch once and stays the aggregate truth. Never raises
+        — this rides the verdict-apply hot path."""
+        try:
+            self.metrics.histogram("ingest_e2e_latency_seconds").observe(
+                lat_s)
+            shards = ()
+            if self._n_shards > 1 and "_shard" in buf:
+                from cilium_tpu.pipeline.scheduler import SHARD_BIN_MASK
+                # valid-masked: the buffer's padding tail carries the
+                # zeroed-row flow hash, which would attribute every batch
+                # to one deterministic shard that carried no traffic
+                bins = (np.asarray(buf["_shard"]) & SHARD_BIN_MASK) - 1
+                bins = bins[np.asarray(buf["valid"])]
+                shards = np.unique(bins[(bins >= 0)
+                                        & (bins < self._n_shards)])
+                for s in shards:
+                    self.metrics.histogram(
+                        f'ingest_e2e_latency_seconds{{shard="{int(s)}"}}'
+                    ).observe(lat_s)
+            if self._slo_s and lat_s > self._slo_s:
+                self.metrics.inc_counter("ingest_e2e_slo_burn_total")
+                for s in shards:
+                    self.metrics.inc_counter(
+                        f'ingest_e2e_slo_burn_total{{shard="{int(s)}"}}')
+                self.slo_burns += 1
+        except Exception:   # noqa: BLE001
+            log.exception("e2e latency observation failed")
 
     def _drain(self) -> None:
         """Stop-path drain: alternate force-harvesting what the batcher
@@ -393,7 +453,7 @@ class ShimFeeder:
             if not self._pending and not harvested:
                 break
             while self._pending:
-                ticket, buf = self._pending.popleft()
+                ticket, buf, ingest_mono = self._pending.popleft()
                 resolved = True
                 if ticket is not None:
                     try:
@@ -405,5 +465,6 @@ class ShimFeeder:
                         resolved = False
                     except Exception:   # noqa: BLE001 — fail-closed below
                         pass
-                self._apply_one(ticket, buf, recycle=resolved)
+                self._apply_one(ticket, buf, recycle=resolved,
+                                ingest_mono=ingest_mono)
         self.metrics.set_gauge("feeder_pending", len(self._pending))

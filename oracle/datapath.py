@@ -209,6 +209,17 @@ def l7_match(http_rules, method: int, path: bytes) -> bool:
 # The oracle
 # --------------------------------------------------------------------------- #
 class Oracle:
+    @classmethod
+    def for_snapshot(cls, snap, ct: Optional[ConntrackTable] = None
+                     ) -> "Oracle":
+        """Oracle over one compiled PolicySnapshot — the ONE place the
+        snapshot→oracle construction (slot-aligned policies, compiled
+        ipcache, the n_frontends LB gate) lives, shared by the fake
+        datapath and the shadow auditor so their replays can never be
+        built against differently-wired oracles."""
+        return cls(dict(zip(snap.ep_ids, snap.policies)), snap.ipcache,
+                   ct=ct, lb=snap.lb if snap.lb.n_frontends else None)
+
     def __init__(self, policies: Dict[int, EndpointPolicy],
                  ipcache_entries: Dict[str, int],
                  ct: Optional[ConntrackTable] = None,
@@ -327,6 +338,35 @@ class Oracle:
                            redirect=True, matched_key=key), True
         return Verdict(True, C.DropReason.OK, status, remote_id,
                        matched_key=key), True
+
+    # -- audit replay (observe/audit.py shadow-oracle parity) ----------------
+    def replay(self, p: PacketRecord, status: int) -> Tuple[Verdict, bool]:
+        """Re-derive the verdict for one packet given an externally observed
+        CT probe result — the shadow-audit replay entry point.
+
+        Runs the exact classify() chain — service DNAT, ipcache LPM, the
+        policy precedence ladder, L7-lite matching — but takes ``status``
+        (the CT state the datapath saw *as of classification*, captured at
+        finalize) as the conntrack truth instead of probing ``self.ct``,
+        and mutates nothing: no CT create/update, safe to call from a
+        background auditor long after the live table has moved on.
+
+        Returns ``(verdict, create)`` where ``create`` is the CT-delta the
+        datapath must have applied for this row (True: an allowed NEW
+        packet creates its forward entry). Reply un-DNAT fields are NOT
+        reconstructed (they come from the live CT entry's rev_nat id, which
+        is not part of the captured probe input); callers check them for
+        structural consistency instead of bit-equality."""
+        tp, rev_nat, no_backend = self._translate(p)
+        if no_backend:
+            return Verdict(False, C.DropReason.NO_SERVICE, C.CTStatus.NEW,
+                           self._remote_identity(p)), False
+        remote_id = self._remote_identity(tp)
+        verdict, create = self._verdict_for(tp, remote_id, status)
+        if rev_nat:
+            verdict = replace(verdict, svc=True, nat_dst=tp.dst_addr,
+                              nat_dport=tp.dst_port)
+        return verdict, create
 
     # -- sequential (true eBPF per-packet semantics) ------------------------
     def classify(self, p: PacketRecord, now: int) -> Verdict:

@@ -545,7 +545,12 @@ class TestAutotuner:
         buckets, counts, _t, _n = h.snapshot()
         assert quantile_from(buckets, counts, 0.5) < 0.01
         assert quantile_from(buckets, counts, 0.99) > 0.05
-        assert quantile_from(buckets, [0] * len(counts), 0.99) == 0.0
+        # the empty-window sentinel (PR 7): a delta histogram with zero
+        # counts between scrapes reads NaN, not a fabricated 0.0 — the
+        # autotuner and the SLO burn math both skip such intervals
+        from cilium_tpu.runtime.metrics import quantile_is_empty
+        assert quantile_is_empty(
+            quantile_from(buckets, [0] * len(counts), 0.99))
 
 
 class TestEngineIntegration:
