@@ -9,7 +9,8 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
-        ingest-smoke multichip-smoke audit-smoke shim bench clean
+        ingest-smoke multichip-smoke audit-smoke kernel-smoke shim bench \
+        clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -57,7 +58,23 @@ audit-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_audit.py -q -m "not slow"
 	$(PYTEST_ENV) python -m pytest tests/test_audit.py -q -m slow
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke
+# Fused-megakernel gate (kernels/fused.py): the tier-1 kernel/parity
+# subset — per-kernel fused-vs-jnp-vs-host parity (LPM fuzz incl. the
+# grid path, CT probe pair, policy+L7+verdict), the fused end-to-end
+# oracle parity suite, selector/memoization units, fused pipeline +
+# 4-shard mesh + audit integration — plus the slow-marked soaks (100k-
+# prefix v6 walk, long-horizon fused parity, audited pipeline soak) and a
+# `bench.py --kernels` round with interpret-mode parity asserted and a
+# second round --compare'd against the first (the per-kernel regression
+# gate). Tier-1 already runs the fused path in interpret mode via
+# tests/test_fused.py, so no PR can land a divergent kernel.
+kernel-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_fused.py tests/test_kernels.py tests/test_parity.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_fused.py -q -m slow
+	$(PYTEST_ENV) python bench.py --kernels --config 3 --batch 1024 --batches 4 --fused on > /tmp/cilium_tpu_kernels_gate.json
+	$(PYTEST_ENV) python bench.py --kernels --config 3 --batch 1024 --batches 4 --fused on --compare /tmp/cilium_tpu_kernels_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -108,6 +108,11 @@ COMPARE_FIELDS = (
     ("e2e_p50_ms", -1),
     ("e2e_p99_ms", -1),
     ("pack_p50_ms", -1),
+    # --kernels artifacts: per-kernel compute-only latency
+    ("kernel_lpm_p50_ms", -1),
+    ("kernel_ct_probe_p50_ms", -1),
+    ("kernel_policy_l7_p50_ms", -1),
+    ("kernel_full_step_p50_ms", -1),
 )
 
 #: max tolerated regression ratio for --compare (generalizes the PR 6
@@ -119,7 +124,8 @@ BENCH_COMPARE_FACTOR = float(os.environ.get(
 
 def _metric_surface(doc: dict) -> dict:
     """The comparable numbers of one artifact, flattened (pack p50 lives
-    in the stage/trace span split depending on the mode)."""
+    in the stage/trace span split depending on the mode; per-kernel p50s
+    come from the --kernels artifact's ``kernels`` block)."""
     out = {}
     for key, _d in COMPARE_FIELDS:
         v = doc.get(key)
@@ -129,6 +135,10 @@ def _metric_surface(doc: dict) -> dict:
     p = (spans.get("datapath.pack") or {}).get("p50_ms")
     if p is not None:
         out["pack_p50_ms"] = p
+    for kname, kdoc in (doc.get("kernels") or {}).items():
+        p = kdoc.get("p50_ms")
+        if isinstance(p, (int, float)):
+            out[f"kernel_{kname}_p50_ms"] = p
     return out
 
 
@@ -1299,6 +1309,259 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
     return doc
 
 
+def kernels_bench(config: int, preset: str, batch: int, batches: int,
+                  verbose: bool = False, fused_mode: str = "auto"):
+    """Per-kernel compute-only microbench of the classify interior
+    (ROADMAP item 2 attribution): the LPM stride walk, the CT probe pair,
+    the policy ladder + L7 matcher + verdict composition, and the full
+    classify step — each as its own jitted program over device-resident
+    batches, timed through the observe tracer's per-kernel span names
+    (``datapath.kernel.*``) so the artifact's p50/p99 flow through the same
+    machinery as the serving-path stage split.
+
+    Executors: the jnp reference always runs. The fused Pallas path
+    (kernels/fused.py) is timed only where it actually compiles —
+    ``fused_mode`` resolved exactly like the serving selector
+    (``DaemonConfig.fused_kernels``) — because interpret-mode wall time
+    measures the Pallas *interpreter*, not the kernel. Off-TPU the fused
+    path is instead PARITY-checked in interpret mode (bit-identical outputs
+    + CT against the jnp reference over every pre-generated batch), so the
+    artifact still proves the fused interior before a TPU ever runs it;
+    the cfg3/cfg4 compute_only movement toward the cfg2 ceiling is the
+    v5e-8 expectation this artifact exists to verify (ROADMAP item 5).
+    """
+    import jax
+    import jax.numpy as jnp
+    from cilium_tpu.compile.ct_layout import make_ct_arrays
+    from cilium_tpu.kernels import conntrack as ctk
+    from cilium_tpu.kernels import fused as fk
+    from cilium_tpu.kernels.classify import (classify_interior_core,
+                                             classify_step)
+    from cilium_tpu.kernels.lpm import lpm_lookup_batch
+    from cilium_tpu.observe.trace import (KERNEL_SPAN_CT_PROBE,
+                                          KERNEL_SPAN_FULL, KERNEL_SPAN_LPM,
+                                          KERNEL_SPAN_POLICY_L7, Tracer)
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import resolve_fused
+    from cilium_tpu.utils import constants as C
+
+    t0 = time.time()
+    snap, gen, v4_only = BUILDERS[config](preset)
+    compile_s = time.time() - t0
+    tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+    make_ct = lambda: {k: jnp.asarray(v)  # noqa: E731
+                       for k, v in make_ct_arrays(snap.ct_config).items()}
+    ct = make_ct()
+    rng = np.random.default_rng(7)
+    host = [gen(rng, batch) for _ in range(min(batches, 8))]
+    dev = [{k: jnp.asarray(v) for k, v in hb.items()} for hb in host]
+    jax.block_until_ready(dev)
+    wi = jnp.int32(snap.world_index)
+
+    fused_active, interpret = resolve_fused(
+        DaemonConfig(fused_kernels=fused_mode))
+    plan = fk.fuse_plan(tensors, ct, v4_only=v4_only)
+    time_fused = fused_active and not interpret   # compiled Pallas only
+
+    def _stage_fns(use_fused):
+        """One jitted program per interior stage; ``use_fused`` swaps the
+        executor, nothing else. The fuse_plan geometry gate applies per
+        stage exactly as classify_step applies it in serving — a gated
+        stage times its real executor (the jnp reference), never a
+        kernel the serving path would refuse."""
+        def lpm_fn(tensors, b, wi):
+            rw = jnp.where((b["direction"] == C.DIR_EGRESS)[:, None],
+                           b["dst"], b["src"])
+            if use_fused and plan.lpm:
+                return fk.lpm_lookup_fused(
+                    tensors["lpm_v4"], tensors["lpm_v6"], rw, b["is_v6"],
+                    wi, v4_only=v4_only, interpret=interpret)
+            return lpm_lookup_batch(tensors["lpm_v4"], tensors["lpm_v6"],
+                                    rw, b["is_v6"], default_index=wi,
+                                    v4_only=v4_only)
+
+        def ct_fn(ct, b, now):
+            fwd, rev = ctk.ct_key_words_pair(b)
+            if use_fused and plan.ct:
+                return fk.ct_probe_pair_fused(
+                    ct, fwd, rev, now, snap.ct_config.probe_depth,
+                    interpret=interpret)
+            return (ctk.ct_probe(ct, fwd, now, snap.ct_config.probe_depth),
+                    ctk.ct_probe(ct, rev, now, snap.ct_config.probe_depth))
+
+        def pol_fn(tensors, b, id_idx, est, reply):
+            args = (tensors, b["ep_slot"], b["direction"], id_idx,
+                    b["proto"], b["dport"], b["http_method"],
+                    b["http_path"], est, reply, b["valid"])
+            if use_fused and plan.policy:
+                return fk.policy_verdict_fused(*args, interpret=interpret)
+            return classify_interior_core(*args)
+
+        def full_fn(tensors, ct, b, now, wi):
+            return classify_step(tensors, ct, b, now, wi,
+                                 probe_depth=snap.ct_config.probe_depth,
+                                 v4_only=v4_only, fused=use_fused,
+                                 fused_interpret=interpret)
+        return {
+            KERNEL_SPAN_LPM: jax.jit(lpm_fn),
+            KERNEL_SPAN_CT_PROBE: jax.jit(ct_fn),
+            KERNEL_SPAN_POLICY_L7: jax.jit(pol_fn),
+            KERNEL_SPAN_FULL: jax.jit(full_fn, donate_argnums=(1,)),
+        }
+
+    # staged inputs shared by the lpm/ct/policy micro-stages: id_idx from a
+    # reference LPM pass; est/reply against the empty table (all-new flows
+    # — the ladder cost is est-independent, it is branch-free)
+    ref = _stage_fns(False)
+    id_idx0 = [ref[KERNEL_SPAN_LPM](tensors, b, wi) for b in dev]
+    n = batch
+    false_col = jnp.zeros((n,), dtype=bool)
+    jax.block_until_ready(id_idx0)
+
+    tracer = Tracer(sample_rate=1.0, capacity=1 << 14)
+    now_ctr = [20_000]
+
+    def _run(span_name, fns, reps):
+        """Time one stage ``reps`` times through the tracer (span per
+        call, device-fenced). The full step threads donated CT."""
+        nonlocal ct
+        calls = {
+            KERNEL_SPAN_LPM:
+                lambda i: fns[KERNEL_SPAN_LPM](
+                    tensors, dev[i % len(dev)], wi),
+            KERNEL_SPAN_CT_PROBE:
+                lambda i: fns[KERNEL_SPAN_CT_PROBE](
+                    ct, dev[i % len(dev)], jnp.uint32(now_ctr[0])),
+            KERNEL_SPAN_POLICY_L7:
+                lambda i: fns[KERNEL_SPAN_POLICY_L7](
+                    tensors, dev[i % len(dev)], id_idx0[i % len(dev)],
+                    false_col, false_col),
+        }
+        if span_name == KERNEL_SPAN_FULL:
+            def call(i):
+                nonlocal ct
+                now_ctr[0] += 1
+                out, ct, _ = fns[KERNEL_SPAN_FULL](
+                    tensors, ct, dev[i % len(dev)],
+                    jnp.uint32(now_ctr[0]), wi)
+                return out
+        else:
+            call = calls[span_name]
+        jax.block_until_ready(call(0))               # warmup/compile
+        for r in range(reps):
+            tid = tracer.maybe_sample()
+            with tracer.span(tid, span_name):
+                jax.block_until_ready(call(r))
+
+    reps = max(8, min(100, batches * 4))
+    stage_names = (KERNEL_SPAN_LPM, KERNEL_SPAN_CT_PROBE,
+                   KERNEL_SPAN_POLICY_L7, KERNEL_SPAN_FULL)
+    for name in stage_names:
+        _run(name, ref, reps)
+    jnp_summary = tracer.summary()
+
+    fused_summary = None
+    if time_fused:
+        tracer.reset()
+        tracer.configure(sample_rate=1.0)
+        ct = make_ct()
+        fus = _stage_fns(True)
+        for name in stage_names:
+            _run(name, fus, reps)
+        fused_summary = tracer.summary()
+
+    def _stage_doc(summary):
+        out = {}
+        for name in stage_names:
+            s = summary.get(name)
+            if s is None:
+                continue
+            key = name.rsplit(".", 1)[1]
+            out[key] = {
+                "p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
+                "flows_per_s": round(batch / (s["p50_ms"] / 1e3), 1),
+            }
+        return out
+
+    # interpret-mode parity: the CPU-CI proof that the fused interior is
+    # bit-identical (outputs + CT + counters) to the jnp reference
+    parity = None
+    if fused_active and interpret:
+        ct_a, ct_b = make_ct(), make_ct()
+        rows = 0
+        for i, b in enumerate(dev):
+            now = jnp.uint32(30_000 + i)
+            out_a, ct_a, cnt_a = classify_step(
+                tensors, ct_a, b, now, wi, v4_only=v4_only)
+            out_b, ct_b, cnt_b = classify_step(
+                tensors, ct_b, b, now, wi, v4_only=v4_only,
+                fused=True, fused_interpret=True)
+            for k in out_a:
+                np.testing.assert_array_equal(
+                    np.asarray(out_a[k]), np.asarray(out_b[k]), k)
+            for k in ct_a:
+                np.testing.assert_array_equal(
+                    np.asarray(ct_a[k]), np.asarray(ct_b[k]), k)
+            for k in cnt_a:
+                np.testing.assert_array_equal(
+                    np.asarray(cnt_a[k]), np.asarray(cnt_b[k]), k)
+            rows += int(np.asarray(b["valid"]).shape[0])
+        parity = {"ok": True, "batches": len(dev), "rows": rows}
+
+    kernels = _stage_doc(jnp_summary)
+    full = kernels.get("full_step", {})
+    result = {
+        "metric": f"kernel_compute_only_{METRIC_NAMES[config]}",
+        "value": full.get("flows_per_s", 0.0),
+        "unit": "flows/sec/chip",
+        "vs_baseline": round(full.get("flows_per_s", 0.0)
+                             / PER_CHIP_TARGET, 4),
+        "compute_only": full.get("flows_per_s", 0.0),
+        "batch": batch,
+        "preset": preset,
+        "reps": reps,
+        "compile_s": round(compile_s, 1),
+        "kernels": kernels,
+        "fused": {
+            "mode": fused_mode,
+            "active": fused_active,
+            "interpret": interpret,
+            "plan": {"lpm": plan.lpm, "ct": plan.ct, "policy": plan.policy},
+            **({"interpret_parity": parity} if parity is not None else {}),
+        },
+    }
+    if fused_summary is not None:
+        fdoc = _stage_doc(fused_summary)
+        result["kernels_fused"] = fdoc
+        # the no-regression gate: a compiled fused kernel slower than the
+        # reference it replaces fails the artifact (main exits 4)
+        gate = {}
+        regressions = []
+        for key, ref_doc in kernels.items():
+            fd = fdoc.get(key)
+            if fd is None or ref_doc["p50_ms"] <= 0:
+                continue
+            ratio = fd["p50_ms"] / ref_doc["p50_ms"]
+            gate[key] = round(ratio, 4)
+            if ratio > 1.05:
+                regressions.append(
+                    f"{key}: fused p50 {fd['p50_ms']}ms > jnp "
+                    f"{ref_doc['p50_ms']}ms")
+        result["fused_gate"] = {
+            "p50_ratio_fused_over_jnp": gate,
+            "failed": bool(regressions),
+            **({"regressions": regressions} if regressions else {}),
+        }
+    if verbose:
+        print(f"# kernels config={config} preset={preset} batch={batch} "
+              f"reps={reps} fused_active={fused_active} "
+              f"interpret={interpret} plan={plan}", file=sys.stderr)
+        for key, d in kernels.items():
+            print(f"#   {key}: p50={d['p50_ms']}ms p99={d['p99_ms']}ms "
+                  f"({d['flows_per_s'] / 1e6:.1f} Mfl/s)", file=sys.stderr)
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=5, choices=sorted(BUILDERS))
@@ -1326,6 +1589,16 @@ def main(argv=None):
     ap.add_argument("--frames", type=int, default=0,
                     help="with --ingest: frames to push (default "
                          "10k smoke / 100k full)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="per-kernel compute-only microbench of the "
+                         "classify interior (lpm / ct_probe / policy_l7 / "
+                         "full_step p50+p99 via the datapath.kernel.* "
+                         "spans); times the fused Pallas path where it "
+                         "compiles and parity-checks it in interpret mode "
+                         "elsewhere")
+    ap.add_argument("--fused", default="auto", choices=["auto", "on", "off"],
+                    help="with --kernels: fused-kernel selector resolved "
+                         "exactly like DaemonConfig.fused_kernels")
     ap.add_argument("--compare", metavar="OLD.json",
                     help="diff this run against a prior JSON artifact "
                          "(pack/fps/e2e ratio-checked against "
@@ -1406,6 +1679,22 @@ def main(argv=None):
             sys.exit(rc)
 
     _start_watchdog(METRIC_NAMES[args.config])
+    if args.kernels:
+        result = kernels_bench(args.config, preset, batch, batches,
+                               verbose=args.verbose, fused_mode=args.fused)
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("fused_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
     if args.ingest:
         result = ingest_bench(preset, batch, n_frames=args.frames,
                               verbose=args.verbose, shards=args.shards)

@@ -7,11 +7,27 @@ batched over N headers").
 Branch-free: every packet takes every path, masks select. XLA fuses the
 elementwise pipeline between the gathers; the scatters at the end form the
 CT write phase.
+
+Two executors serve the hot interior (LPM walk → CT probe pair → policy
+ladder + L7 + verdict composition):
+
+- the **jnp reference** (default): plain XLA ops — portable, and the
+  semantics baseline every other path is pinned against;
+- the **Pallas megakernel path** (``fused=True``): kernels/fused.py runs
+  the same shared core functions inside explicit TPU kernels that keep the
+  walk/probe/ladder state in registers/VMEM instead of materializing ~20
+  intermediate [N] arrays in HBM between stages. On CPU the fused path runs
+  in Pallas interpret mode (``fused_interpret=True``) so CI pins it
+  bit-identical to the reference and the oracle without TPU hardware.
+
+The CT insert/apply phase (scatter-heavy, order-defined aggregation) stays
+on XLA in both modes — scatters are what XLA already does well, and the
+deterministic-winner semantics live in kernels/conntrack.py either way.
 """
 
 from __future__ import annotations
 
-
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -27,9 +43,74 @@ from cilium_tpu.utils import constants as C
 N_REASON_BINS = C.DROP_REASON_BINS   # counter-tensor geometry (one source)
 
 
+def compose_verdict(decision, enforced, cell_redirect, l7_fail,
+                    est, reply, valid):
+    """Step 5 of the datapath: (decision, l7_fail) → allow/reason/status/
+    redirect, with no intermediate leaving the caller's scope. Shared
+    verbatim by the jnp reference and the fused Pallas kernel body
+    (kernels/fused.py) — the single source of the composition semantics;
+    the L7-gating inputs (``cell_redirect``/``l7_fail``) come from
+    :func:`classify_interior_core`, their single source."""
+    hit = est | reply
+    new_allow = jnp.where(
+        decision == C.VERDICT_DENY, False,
+        jnp.where(decision == C.VERDICT_MISS, ~enforced,
+                  ~l7_fail))  # ALLOW always passes; REDIRECT unless l7_fail
+    allow = jnp.where(hit, ~l7_fail, new_allow) & valid
+    reason = jnp.where(
+        hit,
+        jnp.where(l7_fail, int(C.DropReason.POLICY_L7), int(C.DropReason.OK)),
+        jnp.where(
+            decision == C.VERDICT_DENY, int(C.DropReason.POLICY_DENY),
+            jnp.where(decision == C.VERDICT_MISS,
+                      jnp.where(enforced, int(C.DropReason.POLICY),
+                                int(C.DropReason.OK)),
+                      jnp.where(l7_fail, int(C.DropReason.POLICY_L7),
+                                int(C.DropReason.OK)))),
+    ).astype(jnp.int32)
+    status = jnp.where(est, int(C.CTStatus.ESTABLISHED),
+                       jnp.where(reply, int(C.CTStatus.REPLY),
+                                 int(C.CTStatus.NEW))).astype(jnp.int32)
+    redirect = valid & cell_redirect
+    return allow, reason, status, redirect
+
+
+def classify_interior_core(tensors, ep_slot, direction, id_idx, proto,
+                           dport, http_method, http_path, est, reply, valid,
+                           rule_axis=None):
+    """Steps 3-5 of the datapath (policy ladder → L7 token match → verdict
+    composition) as one pure function of the snapshot tensor dict + row
+    columns. This is the *fusable core*: the jnp reference calls it on XLA
+    arrays, the Pallas verdict kernel (kernels/fused.py) calls the exact
+    same function on values read from VMEM refs — so
+    ``decision → l7_cell → l7_match → allow/reason`` never round-trips
+    through HBM on the fused path, and bit-identity between the executors
+    holds by construction.
+
+    → (allow [N] bool, reason [N] int32, status [N] int32,
+    redirect [N] bool); the NO_SERVICE override for LB no-backend drops is
+    the caller's job (it precedes this stage's inputs either way)."""
+    decision, l7_cell, enforced = policy_lookup_batch(
+        tensors, ep_slot, direction, id_idx, proto, dport,
+        rule_axis=rule_axis)
+    # L7-lite: the CURRENT policy cell's rules apply to every packet with
+    # tokens — new and established flows alike (the per-request proxy
+    # semantics; CT entries carry no L7 state, so policy swaps need no
+    # remap)
+    has_tokens = (http_method != C.HTTP_METHOD_ANY) \
+        | (http_path != 0).any(axis=-1)
+    cell_redirect = decision == C.VERDICT_REDIRECT
+    set_to_check = jnp.where(cell_redirect, l7_cell, 0)
+    l7_ok = l7_match_batch(tensors, set_to_check, http_method, http_path)
+    l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
+    return compose_verdict(decision, enforced, cell_redirect, l7_fail,
+                           est, reply, valid)
+
+
 def classify_step(tensors, ct, batch, now, world_index=0, *,
                   probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
-                  rule_axis=None, lb_probe_depth: int = 8):
+                  rule_axis=None, lb_probe_depth: int = 8,
+                  fused: bool = False, fused_interpret: bool = False):
     # ``world_index`` is a traced scalar (not static): it changes whenever the
     # identity table grows, and baking it in would force a re-jit per snapshot.
     # ``rule_axis`` names a mesh axis for rule-space (verdict-row) sharding.
@@ -42,9 +123,22 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     uint32, rnat_sport [N] int32 (reply un-DNAT).
     counters: by_reason_dir [COUNTER_CELLS] uint32 (reasons x directions),
     insert_fail uint32 scalar.
-    """
+
+    ``fused=True`` routes the interior through the Pallas kernels of
+    kernels/fused.py where each stage's static geometry permits
+    (kernels/fused.fuse_plan — VMEM-resident tables, no rule-axis psum);
+    ineligible stages fall back to the jnp reference per stage, so the
+    choice is a per-shape trace-time constant, never data-dependent.
+    ``fused_interpret`` runs those kernels in the Pallas interpreter (the
+    CPU-CI bit-identity mode)."""
     valid = batch["valid"]
     direction = batch["direction"]
+    if fused:
+        from cilium_tpu.kernels import fused as fk
+        plan = fk.fuse_plan(tensors, ct, v4_only=v4_only,
+                            rule_axis=rule_axis)
+    else:
+        plan = None
 
     # 0. service LB (bpf/lib/lb.h analog): frontend match → Maglev backend →
     # DNAT. Everything downstream (LPM, CT, policy) sees the translated
@@ -68,60 +162,47 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     # 1. ipcache LPM: remote = dst on egress, src on ingress
     remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
                              batch["dst"], batch["src"])
-    id_idx = lpm_lookup_batch(tensors["lpm_v4"], tensors["lpm_v6"],
-                              remote_words, batch["is_v6"],
-                              default_index=world_index, v4_only=v4_only)
+    if plan is not None and plan.lpm:
+        id_idx = fk.lpm_lookup_fused(
+            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
+            batch["is_v6"], world_index, v4_only=v4_only,
+            interpret=fused_interpret)
+    else:
+        id_idx = lpm_lookup_batch(tensors["lpm_v4"], tensors["lpm_v6"],
+                                  remote_words, batch["is_v6"],
+                                  default_index=world_index,
+                                  v4_only=v4_only)
     remote_identity = tensors["identity_ids"][id_idx].astype(jnp.uint32)
 
-    # 2. conntrack probe (batch-start snapshot)
-    fwd_keys = ctk.ct_key_words_jnp(batch, reverse=False)
-    rev_keys = ctk.ct_key_words_jnp(batch, reverse=True)
-    fwd_slot = ctk.ct_probe(ct, fwd_keys, now, probe_depth)
-    rev_slot = ctk.ct_probe(ct, rev_keys, now, probe_depth)
+    # 2. conntrack probe (batch-start snapshot); the reverse key is a word
+    # permutation of the forward key — normalized once, derived twice
+    fwd_keys, rev_keys = ctk.ct_key_words_pair(batch)
+    if plan is not None and plan.ct:
+        fwd_slot, rev_slot = fk.ct_probe_pair_fused(
+            ct, fwd_keys, rev_keys, now, probe_depth,
+            interpret=fused_interpret)
+    else:
+        fwd_slot = ctk.ct_probe(ct, fwd_keys, now, probe_depth)
+        rev_slot = ctk.ct_probe(ct, rev_keys, now, probe_depth)
     est = valid & (fwd_slot >= 0)
     reply = valid & ~est & (rev_slot >= 0)
     new = valid & ~est & ~reply
     hit = est | reply
     hit_slot = jnp.where(est, fwd_slot, jnp.where(reply, rev_slot, 0))
 
-    # 3. policy (ladder already resolved into the dense image)
-    decision, l7_cell, enforced = policy_lookup_batch(
-        tensors, batch["ep_slot"], direction, id_idx,
-        batch["proto"], batch["dport"], rule_axis=rule_axis)
-    cell_redirect = decision == C.VERDICT_REDIRECT
-
-    # 4. L7-lite: the CURRENT policy cell's rules apply to every packet with
-    # tokens — new and established flows alike (the per-request proxy
-    # semantics; CT entries carry no L7 state, so policy swaps need no remap)
-    has_tokens = (batch["http_method"] != C.HTTP_METHOD_ANY) \
-        | (batch["http_path"] != 0).any(axis=-1)
-    set_to_check = jnp.where(cell_redirect, l7_cell, 0)
-    l7_ok = l7_match_batch(tensors, set_to_check, batch["http_method"],
-                           batch["http_path"])
-    l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
-
-    # 5. verdict composition (mirrors oracle classify())
-    new_allow = jnp.where(
-        decision == C.VERDICT_DENY, False,
-        jnp.where(decision == C.VERDICT_MISS, ~enforced,
-                  ~l7_fail))  # ALLOW always passes; REDIRECT unless l7_fail
-    allow = jnp.where(hit, ~l7_fail, new_allow) & valid
-    reason = jnp.where(
-        hit,
-        jnp.where(l7_fail, int(C.DropReason.POLICY_L7), int(C.DropReason.OK)),
-        jnp.where(
-            decision == C.VERDICT_DENY, int(C.DropReason.POLICY_DENY),
-            jnp.where(decision == C.VERDICT_MISS,
-                      jnp.where(enforced, int(C.DropReason.POLICY),
-                                int(C.DropReason.OK)),
-                      jnp.where(l7_fail, int(C.DropReason.POLICY_L7),
-                                int(C.DropReason.OK)))),
-    ).astype(jnp.int32)
+    # 3-5. policy ladder + L7 token match + verdict composition (the fused
+    # interior; see classify_interior_core)
+    if plan is not None and plan.policy:
+        allow, reason, status, redirect = fk.policy_verdict_fused(
+            tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
+            batch["dport"], batch["http_method"], batch["http_path"],
+            est, reply, valid, interpret=fused_interpret)
+    else:
+        allow, reason, status, redirect = classify_interior_core(
+            tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
+            batch["dport"], batch["http_method"], batch["http_path"],
+            est, reply, valid, rule_axis=rule_axis)
     reason = jnp.where(no_backend, int(C.DropReason.NO_SERVICE), reason)
-    status = jnp.where(est, int(C.CTStatus.ESTABLISHED),
-                       jnp.where(reply, int(C.CTStatus.REPLY),
-                                 int(C.CTStatus.NEW))).astype(jnp.int32)
-    redirect = valid & cell_redirect
 
     # 6. CT insert for allowed new flows, then aggregate effects
     want_insert = new & allow
@@ -182,21 +263,51 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     return out, new_ct, counters
 
 
+#: make_classify_fn memo: repeated snapshot placements / engine restarts
+#: previously built a FRESH closure (and so a fresh jit cache) per call —
+#: every placement re-traced shapes the daemon had already compiled. One
+#: jitted fn per static-config key; jax's own cache then dedupes per shape.
+_FN_CACHE: dict = {}
+_FN_LOCK = threading.Lock()
+
+
 def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
-                     donate_ct: bool = True, packed: bool = False):
+                     donate_ct: bool = True, packed: bool = False,
+                     lb_probe_depth: int = 8, fused: bool = False,
+                     fused_interpret: bool = False):
     """jit-compiled classify step. CT buffers are donated (in-place update,
     no double allocation); re-traces only when array shapes change.
+
+    Memoized on the full static-argument key — callers that rebuild their
+    datapath (engine restarts, repeated placements, tests) share one jitted
+    callable and therefore one trace cache instead of re-tracing identical
+    shapes per closure.
 
     ``packed=True``: the batch argument is the single contiguous uint32 wire
     array (kernels/records.pack_batch) — one host→device transfer instead of
     twelve; unpacking happens on device and fuses into the pipeline. This is
     the transfer-bound production path; the dict path stays for tests. The
     wire width selects the variant at trace time: 4 words = compact v4
-    (pack_batch_v4), otherwise the full/L7 layout."""
+    (pack_batch_v4), otherwise the full/L7 layout.
+
+    ``fused``/``fused_interpret``: route the classify interior through the
+    Pallas kernels (kernels/fused.py), optionally in interpreter mode (the
+    CPU-CI bit-identity configuration) — see classify_step."""
+    key = (probe_depth, v4_only, donate_ct, packed, lb_probe_depth,
+           fused, fused_interpret)
+    with _FN_LOCK:
+        fn = _FN_CACHE.get(key)
+        if fn is not None:
+            return fn
+
     def fn(tensors, ct, batch, now, world_index):
         if packed:
             from cilium_tpu.kernels.records import unpack_wire_jnp
             batch = unpack_wire_jnp(batch)
         return classify_step(tensors, ct, batch, now, world_index,
-                             probe_depth=probe_depth, v4_only=v4_only)
-    return jax.jit(fn, donate_argnums=(1,) if donate_ct else ())
+                             probe_depth=probe_depth, v4_only=v4_only,
+                             lb_probe_depth=lb_probe_depth, fused=fused,
+                             fused_interpret=fused_interpret)
+    fn = jax.jit(fn, donate_argnums=(1,) if donate_ct else ())
+    with _FN_LOCK:
+        return _FN_CACHE.setdefault(key, fn)

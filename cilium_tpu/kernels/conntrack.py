@@ -28,18 +28,57 @@ def ct_key_words_jnp(batch, reverse: bool = False):
     return ct_key_words_generic(jnp, batch, reverse)
 
 
-def ct_probe(ct, keys, now, probe_depth: int = PROBE_DEPTH):
-    """Find each key's live slot. → slot [N] int32 (-1 = miss)."""
-    cap = ct["expiry"].shape[0]
+def reverse_key_words_jnp(fwd_keys):
+    """[N,10] forward CT key words → reverse orientation, derived by word
+    ops instead of re-normalizing the tuple columns (the device twin of
+    parallel/mesh._reverse_key_words): addr blocks swap, the port word
+    rotates by 16 (sport<<16|dport → dport<<16|sport), and the direction
+    byte flips (0 ↔ 1). Bit-identical to ``ct_key_words_jnp(reverse=True)``
+    for any batch whose direction column is 0/1 — which the wire formats
+    guarantee (direction rides a single bit)."""
+    w8 = fwd_keys[:, 8]
+    w9 = fwd_keys[:, 9]
+    return jnp.concatenate([
+        fwd_keys[:, 4:8], fwd_keys[:, 0:4],
+        ((w8 << jnp.uint32(16)) | (w8 >> jnp.uint32(16)))[:, None],
+        ((w9 & jnp.uint32(0xFFFFFF00))
+         | (jnp.uint32(1) - (w9 & jnp.uint32(0xFF))))[:, None],
+    ], axis=-1)
+
+
+def ct_key_words_pair(batch):
+    """→ (fwd_keys, rev_keys), both [N,10] uint32, sharing one pass over
+    the tuple columns. ``classify_step`` previously normalized the same
+    src/dst/port/proto fields twice (forward + reverse stacks); the reverse
+    orientation is a cheap word permutation of the forward words, so the
+    jnp fallback path wins this independently of any Pallas fusion."""
+    fwd = ct_key_words_jnp(batch, reverse=False)
+    return fwd, reverse_key_words_jnp(fwd)
+
+
+def ct_probe_core(tab_keys, expiry, keys, now,
+                  probe_depth: int = PROBE_DEPTH):
+    """The fusable probe core over plain arrays (tab_keys [cap,10] uint32,
+    expiry [cap] uint32): find each key's live slot → [N] int32 (-1 =
+    miss). Shared verbatim by the XLA reference (``ct_probe``) and the
+    fused Pallas probe-pair body (kernels/fused.py), which calls it twice
+    on VMEM-resident table values — once per orientation — so the bucket
+    loads never round-trip through HBM between the probes."""
+    cap = expiry.shape[0]
     mask = cap - 1
     base = (hash_words_jnp(keys) & jnp.uint32(mask)).astype(jnp.int32)
     found = jnp.full(base.shape, -1, dtype=jnp.int32)
     for i in range(probe_depth):
         s = (base + i) & mask
-        live = ct["expiry"][s] > now
-        eq = jnp.all(ct["keys"][s] == keys, axis=-1) & live
+        live = expiry[s] > now
+        eq = jnp.all(tab_keys[s] == keys, axis=-1) & live
         found = jnp.where((found < 0) & eq, s, found)
     return found
+
+
+def ct_probe(ct, keys, now, probe_depth: int = PROBE_DEPTH):
+    """Find each key's live slot. → slot [N] int32 (-1 = miss)."""
+    return ct_probe_core(ct["keys"], ct["expiry"], keys, now, probe_depth)
 
 
 def _flag_delta(proto, tcp_flags, is_reply):

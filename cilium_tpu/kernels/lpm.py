@@ -4,6 +4,15 @@ Fixed-depth gather chain, no data-dependent control flow: dead paths idle in
 the sentinel node. A mixed-family batch walks both tries and selects by the
 family bit (mirroring upstream's two LPM maps); ``v4_only=True`` (static)
 skips the 16-level v6 walk for pure-IPv4 workloads (BASELINE config 1).
+
+``lpm_walk_core`` is the *fusable core*: pure jnp over plain arrays, so the
+exact same function executes (a) as the XLA reference here and (b) inside
+the Pallas megakernel body (kernels/fused.py) over values read from refs —
+bit-identity between the two paths holds by construction, not by test luck.
+The per-level gather is flattened to a single-axis ``take`` (node*256+byte)
+so the Mosaic lowering sees one supported gather per level instead of a 3-D
+fancy index; in-range indices make it bit-identical to the 2-D form (node is
+always a real node or the dead sentinel, byte is masked to 0..255).
 """
 
 from __future__ import annotations
@@ -15,10 +24,13 @@ from cilium_tpu.compile.lpm import V4_LEVELS, V6_LEVELS
 
 def _walk(nodes, addr_words, byte_index, levels, default_index):
     """nodes [n,256,2] int32; addr_words [N,4] uint32; byte_index(l) gives the
-    byte position 0..15 in the 16-byte address for level l."""
+    byte position 0..15 in the 16-byte address for level l. ``node`` and
+    ``best`` live in registers across the whole chain — nothing but the
+    node-pair gather touches memory per level."""
     n_nodes = nodes.shape[0]
     dead = n_nodes - 1
     n = addr_words.shape[0]
+    flat = nodes.reshape(-1, 2)
     node = jnp.zeros((n,), dtype=jnp.int32)
     # default_index may be a traced scalar (snapshot-dependent) — broadcast,
     # don't bake
@@ -28,19 +40,28 @@ def _walk(nodes, addr_words, byte_index, levels, default_index):
         word = addr_words[:, pos // 4]
         b = ((word >> jnp.uint32(8 * (3 - pos % 4))) & jnp.uint32(0xFF)
              ).astype(jnp.int32)
-        pair = nodes[node, b]                     # [N, 2]
+        pair = flat[node * 256 + b]               # [N, 2]
         child, value = pair[:, 0], pair[:, 1]
         best = jnp.where(value >= 0, value, best)
         node = jnp.where(child >= 0, child, dead)
     return best
 
 
-def lpm_lookup_batch(lpm_v4, lpm_v6, addr_words, is_v6, default_index: int,
-                     v4_only: bool = False):
-    """addr_words [N,4] uint32 (16-byte normalized, v4-mapped) → identity
-    index [N] int32."""
+def lpm_walk_core(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
+                  v4_only: bool = False):
+    """The fusable core: [N,4] v4-mapped address words → identity index
+    [N] int32. ``is_v6`` may be bool or a 0/1 integer mask (the Pallas body
+    ships it as int32). ``v4_only`` (static) elides the 16-level v6 chain."""
     r4 = _walk(lpm_v4, addr_words, lambda l: 12 + l, V4_LEVELS, default_index)
     if v4_only:
         return r4
     r6 = _walk(lpm_v6, addr_words, lambda l: l, V6_LEVELS, default_index)
-    return jnp.where(is_v6, r6, r4)
+    return jnp.where(is_v6.astype(bool), r6, r4)
+
+
+def lpm_lookup_batch(lpm_v4, lpm_v6, addr_words, is_v6, default_index: int,
+                     v4_only: bool = False):
+    """addr_words [N,4] uint32 (16-byte normalized, v4-mapped) → identity
+    index [N] int32."""
+    return lpm_walk_core(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
+                         v4_only=v4_only)

@@ -1,12 +1,42 @@
 """Dense policy lookup: the whole wildcard ladder in three gathers
 (upstream: bpf/lib/policy.h policy_can_access's 6-lookup ladder, resolved at
-compile time by compile/policy_image.py)."""
+compile time by compile/policy_image.py).
+
+``policy_core`` is the *fusable core*: pure jnp over the snapshot's tensor
+dict, shared verbatim by the XLA reference and the fused Pallas verdict
+kernel (kernels/fused.py). Every gather is explicitly clipped then
+flattened to a single-axis take — the clip reproduces jax's out-of-bounds
+clamp semantics exactly (so garbage rows cannot diverge between the two
+executors) and the flat form is the one gather shape Mosaic lowers."""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from cilium_tpu.utils import constants as C
+
+
+def policy_core(tensors, ep_slot, direction, id_index, proto, dport):
+    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool) against
+    the dense (un-sharded) verdict image."""
+    n_ids = tensors["id_class_of"].shape[0]
+    id_cls = tensors["id_class_of"][jnp.clip(id_index, 0, n_ids - 1)]
+    fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
+    n_ports = tensors["port_class"].shape[1]
+    pcls = tensors["port_class"].reshape(-1)[
+        fam * n_ports + jnp.clip(dport, 0, n_ports - 1)]
+    v = tensors["verdict"]
+    n_eps, _, n_rows, n_cols = v.shape
+    ep = jnp.clip(ep_slot, 0, n_eps - 1)
+    d = jnp.clip(direction, 0, 1)
+    cls = jnp.clip(id_cls, 0, n_rows - 1)
+    pc = jnp.clip(pcls, 0, n_cols - 1)
+    cell = v.reshape(-1)[((ep * 2 + d) * n_rows + cls) * n_cols
+                         + pc].astype(jnp.int32)
+    enforced = tensors["enforced"].reshape(-1)[ep * 2 + d].astype(bool)
+    decision = cell & C.VERDICT_DECISION_MASK
+    l7_id = cell >> C.VERDICT_L7_SHIFT
+    return decision, l7_id, enforced
 
 
 def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
@@ -19,23 +49,23 @@ def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
     psum combines — one XLA collective, no gather of remote rows. Rows must
     be padded to a multiple of the axis size (compile/parallel handles it).
     """
+    if rule_axis is None:
+        return policy_core(tensors, ep_slot, direction, id_index, proto,
+                           dport)
+    import jax
     id_cls = tensors["id_class_of"][id_index]
     fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
     pcls = tensors["port_class"][fam, jnp.clip(dport, 0, 65535)]
-    if rule_axis is None:
-        cell = tensors["verdict"][ep_slot, direction, id_cls, pcls].astype(jnp.int32)
-    else:
-        import jax
-        rows_local = tensors["verdict"].shape[2]
-        ri = jax.lax.axis_index(rule_axis)
-        local_idx = id_cls - ri * rows_local
-        in_range = (local_idx >= 0) & (local_idx < rows_local)
-        safe = jnp.clip(local_idx, 0, rows_local - 1)
-        cell_local = jnp.where(
-            in_range,
-            tensors["verdict"][ep_slot, direction, safe, pcls].astype(jnp.int32),
-            0)
-        cell = jax.lax.psum(cell_local, rule_axis)
+    rows_local = tensors["verdict"].shape[2]
+    ri = jax.lax.axis_index(rule_axis)
+    local_idx = id_cls - ri * rows_local
+    in_range = (local_idx >= 0) & (local_idx < rows_local)
+    safe = jnp.clip(local_idx, 0, rows_local - 1)
+    cell_local = jnp.where(
+        in_range,
+        tensors["verdict"][ep_slot, direction, safe, pcls].astype(jnp.int32),
+        0)
+    cell = jax.lax.psum(cell_local, rule_axis)
     enforced = tensors["enforced"][ep_slot, direction]
     decision = cell & C.VERDICT_DECISION_MASK
     l7_id = cell >> C.VERDICT_L7_SHIFT

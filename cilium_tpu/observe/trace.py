@@ -38,6 +38,20 @@ _Span = Tuple[int, str, float, float, Optional[dict]]
 
 DEFAULT_CAPACITY = 4096
 
+#: Per-kernel attribution span names for the classify interior (ROADMAP
+#: item 2). A single jit cannot be split from the host, so the serving
+#: path's ``datapath.compute`` span carries a ``fused`` attr naming the
+#: executor (JITDatapath), while ``bench.py --kernels`` times each stage as
+#: its own jitted program under these span names — the artifact's
+#: per-kernel p50/p99 all flow through this tracer, same as the pipeline
+#: stage split. One name per fused kernel plus the whole interior.
+KERNEL_SPAN_LPM = "datapath.kernel.lpm"
+KERNEL_SPAN_CT_PROBE = "datapath.kernel.ct_probe"
+KERNEL_SPAN_POLICY_L7 = "datapath.kernel.policy_l7"
+KERNEL_SPAN_FULL = "datapath.kernel.full_step"
+KERNEL_SPANS = (KERNEL_SPAN_LPM, KERNEL_SPAN_CT_PROBE,
+                KERNEL_SPAN_POLICY_L7, KERNEL_SPAN_FULL)
+
 
 class _NullSpan:
     """Shared no-op context for unsampled events (no allocation per call)."""

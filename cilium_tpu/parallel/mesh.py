@@ -271,8 +271,18 @@ def rehash_ct_arrays(arrays: Dict[str, np.ndarray], n_flow_shards: int,
 # The meshed classify step
 # --------------------------------------------------------------------------- #
 def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
-                             v4_only: bool = False, donate_ct: bool = True):
+                             v4_only: bool = False, donate_ct: bool = True,
+                             fused: bool = False,
+                             fused_interpret: bool = False):
     """shard_map'd + jitted classify step over ``mesh`` ('flows','rules').
+
+    ``fused``/``fused_interpret`` route each shard's classify interior
+    through the Pallas megakernels (kernels/fused.py) exactly like the
+    single-chip ``make_classify_fn`` — the kernels run on per-shard local
+    arrays inside the shard_map body, so the mesh geometry is unchanged.
+    With rule sharding the policy/L7 stage stays on the jnp reference (its
+    psum must remain in the shard_map body); LPM and the CT probe pair
+    still fuse per shard.
 
     Call with (tensors, ct, batch, now, world_index) where batch rows are
     steered (steer_batch) and verdict rows padded (pad_snapshot_tensors).
@@ -306,7 +316,8 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
     def local_fn(tensors, ct, batch, now, world_index):
         out, new_ct, counters = classify_step(
             tensors, ct, batch, now, world_index,
-            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis)
+            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis,
+            fused=fused, fused_interpret=fused_interpret)
         # counters are global: reduce over 'flows' only — along 'rules' the
         # batch is replicated and every shard computes identical counts
         # (summing there would multiply by the rules-axis size)

@@ -172,6 +172,9 @@ def status_doc(engine: "Engine") -> Dict:
         "services": len(engine.ctx.services.all()),
         "conntrack": {"capacity": ct["capacity"], "live": ct["live"]},
         "enforcement_mode": engine.ctx.enforcement_mode,
+        # Pallas megakernel selector state (None on jax-free backends —
+        # the oracle-backed fake has no kernels to fuse)
+        "fused_kernels": getattr(engine.datapath, "fused_state", None),
         # None until the ingestion pipeline has been started
         "pipeline": engine.pipeline_stats(),
         # None until a shim feeder is attached (Engine.start_feeder)

@@ -33,6 +33,11 @@ class DaemonConfig:
     n_shards: int = 1              # data-parallel flow shards (mesh size)
     rule_shards: int = 1           # rule-space (verdict-row) shards
     donate_ct: bool = True
+    # Pallas megakernel selector for the classify interior (kernels/fused.py):
+    # "auto" compiles the fused path on TPU and keeps the jnp reference
+    # elsewhere; "on" forces it everywhere (Pallas interpret mode off-TPU —
+    # the CPU-CI bit-identity configuration); "off" pins the jnp reference.
+    fused_kernels: str = "auto"    # auto | on | off
     # --- lifecycle ---
     state_dir: str = "/var/run/cilium-tpu"
     sweep_interval_s: float = 30.0
@@ -120,6 +125,10 @@ class DaemonConfig:
             raise ValueError("ct_capacity must be a power of two")
         if self.flowlog_mode not in ("all", "drops", "none"):
             raise ValueError(f"bad flowlog mode {self.flowlog_mode!r}")
+        if self.fused_kernels not in ("auto", "on", "off"):
+            raise ValueError(
+                f"bad fused_kernels mode {self.fused_kernels!r} "
+                "(auto | on | off)")
         if self.pipeline_admission not in ("block", "drop"):
             raise ValueError(
                 f"bad pipeline admission {self.pipeline_admission!r}")

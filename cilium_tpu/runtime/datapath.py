@@ -47,6 +47,23 @@ CT_SCHEMA_KEYS = frozenset(
     ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev", "rev_nat"))
 
 
+def resolve_fused(config: DaemonConfig) -> Tuple[bool, bool]:
+    """``DaemonConfig.fused_kernels`` → (fused, interpret) for the Pallas
+    classify-interior kernels (kernels/fused.py). ``auto`` compiles the
+    fused path only on TPU (the jnp reference is the right executor for
+    CPU/interpret anyway); ``on`` forces the fused path everywhere, in
+    Pallas interpret mode off-TPU — the configuration CPU CI uses to pin
+    the fused kernels bit-identical to the reference and the oracle."""
+    mode = config.fused_kernels
+    if mode == "off":
+        return False, False
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "on":
+        return True, not on_tpu
+    return (True, False) if on_tpu else (False, False)   # auto
+
+
 def normalize_ct_arrays(arrays: Dict[str, np.ndarray]
                         ) -> Dict[str, np.ndarray]:
     """Validate/upgrade a ct_layout checkpoint to the current schema —
@@ -189,6 +206,9 @@ class JITDatapath(DatapathBackend):
         self._sharded = self.n_flow_shards * self.n_rule_shards > 1
         ct_host = make_ct_arrays(CTConfig(self.config.ct_capacity,
                                           self.config.probe_depth))
+        # Pallas megakernel selector (kernels/fused.py): trace-time static,
+        # so both classify fns below bake the choice into their jit keys
+        self._fused, self._fused_interpret = resolve_fused(self.config)
         if self._sharded:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from cilium_tpu.parallel.mesh import (
@@ -211,7 +231,9 @@ class JITDatapath(DatapathBackend):
                 self._mesh,
                 probe_depth=self.config.probe_depth,
                 v4_only=self.config.v4_only,
-                donate_ct=self.config.donate_ct)
+                donate_ct=self.config.donate_ct,
+                fused=self._fused,
+                fused_interpret=self._fused_interpret)
         else:
             from cilium_tpu.kernels.classify import make_classify_fn
             self._ct = {k: jnp.asarray(v) for k, v in ct_host.items()}
@@ -222,7 +244,9 @@ class JITDatapath(DatapathBackend):
                 probe_depth=self.config.probe_depth,
                 v4_only=self.config.v4_only,
                 donate_ct=self.config.donate_ct,
-                packed=True)
+                packed=True,
+                fused=self._fused,
+                fused_interpret=self._fused_interpret)
         # donated CT buffers make concurrent classify a use-after-donate;
         # serialize the device step (host-side controllers may call in)
         self._ct_lock = threading.Lock()
@@ -281,6 +305,18 @@ class JITDatapath(DatapathBackend):
         shards replicate the batch, so a rules-only mesh needs no row
         grouping at all."""
         return self.n_flow_shards if self._sharded else 1
+
+    @property
+    def fused_state(self) -> Dict[str, Any]:
+        """Operator-facing view of the megakernel selector: the configured
+        mode, whether the fused path is active, and whether it runs in
+        Pallas interpret mode (off-TPU ``fused_kernels=on`` — the CI
+        bit-identity configuration, not a serving configuration)."""
+        return {
+            "mode": self.config.fused_kernels,
+            "active": self._fused,
+            "interpret": self._fused_interpret,
+        }
 
     def _maybe_reset_wire_flags(self, snap: PolicySnapshot) -> None:
         """Un-stick the widened wire formats when the NEW snapshot provably
@@ -486,7 +522,12 @@ class JITDatapath(DatapathBackend):
                 self._ct = new_ct
 
         def finalize():
-            with tracer.span(trace_id, "datapath.compute"):
+            # the ``fused`` tag attributes compute time to the executor
+            # that produced it (Pallas megakernels vs the jnp reference) —
+            # the per-kernel split itself lives in bench.py --kernels,
+            # since stages inside one jit are not separately timeable
+            with tracer.span(trace_id, "datapath.compute",
+                             fused=int(self._fused)):
                 out_np = {k: np.asarray(v) for k, v in out.items()}
                 counters_np = {k: np.asarray(v)
                                for k, v in counters.items()}
@@ -627,7 +668,8 @@ class JITDatapath(DatapathBackend):
                 self._ct = new_ct
 
         def finalize():
-            with tracer.span(trace_id, "datapath.compute"):
+            with tracer.span(trace_id, "datapath.compute",
+                             fused=int(self._fused)):
                 out_np = {k: np.asarray(v) for k, v in out.items()}
                 counters_np = {k: np.asarray(v)
                                for k, v in counters.items()}
