@@ -9,8 +9,8 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
-        ingest-smoke multichip-smoke audit-smoke kernel-smoke shim bench \
-        clean
+        ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
+        shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -74,7 +74,23 @@ kernel-smoke:
 	$(PYTEST_ENV) python bench.py --kernels --config 3 --batch 1024 --batches 4 --fused on > /tmp/cilium_tpu_kernels_gate.json
 	$(PYTEST_ENV) python bench.py --kernels --config 3 --batch 1024 --batches 4 --fused on --compare /tmp/cilium_tpu_kernels_gate.json > /dev/null
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke
+# Live-update gate (compile/incremental delta path + runtime/datapath
+# scatter-apply + overlapped CT GC): the tier-1 subset — delta-patch
+# bit-identity vs the oracle on warm geometry, the StalePlacement donation
+# fence + engine retry, sharded scatter parity, chunk-sweep == whole-table
+# sweep, CT restart survival, the bounded classify-fn memo — plus the
+# slow-marked soaks (restart-mid-soak, the policy storm audited at
+# sampling 1.0) and a `bench.py --update-storm` round whose artifact gate
+# (parity mismatches, delta-path usage, GC churn ratio, the ≥50x rule-add
+# bar) exits 4 on failure, --compare'd against itself for the
+# round-over-round surface.
+update-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_update_storm.py tests/test_incremental.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_update_storm.py -q -m slow
+	$(PYTEST_ENV) python bench.py --update-storm --preset smoke > /tmp/cilium_tpu_update_gate.json
+	$(PYTEST_ENV) python bench.py --update-storm --preset smoke --compare /tmp/cilium_tpu_update_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -108,6 +108,10 @@ COMPARE_FIELDS = (
     ("e2e_p50_ms", -1),
     ("e2e_p99_ms", -1),
     ("pack_p50_ms", -1),
+    # --update-storm artifacts: live-patch latency under pipelined traffic
+    ("rule_add_ms", -1),
+    ("rule_add_p99_ms", -1),
+    ("device_apply_p50_ms", -1),
     # --kernels artifacts: per-kernel compute-only latency
     ("kernel_lpm_p50_ms", -1),
     ("kernel_ct_probe_p50_ms", -1),
@@ -531,6 +535,300 @@ def update_latency_bench(preset):
         "rule_add_ms": round(add_s * 1e3, 2),
         "rule_remove_ms": round(remove_s * 1e3, 2),
         "speedup_vs_full": round(full_s / max(add_s, 1e-9), 1),
+    }
+
+
+#: BENCH_r05-era incremental-update reference (full cfg5 world, host
+#: COW-copy path): what the ≥50x acceptance gate for the delta-patch
+#: path is judged against. Override when re-baselining on other hardware.
+REF_RULE_ADD_MS = float(os.environ.get(
+    "CILIUM_TPU_BENCH_REF_RULE_ADD_MS", "619.5"))
+
+
+def update_storm_bench(preset: str, updates: int = 0, traffic_batch: int = 512,
+                       verbose: bool = False):
+    """Live policy patching under pipelined traffic (ROADMAP item 3a).
+
+    Builds the cfg5 control plane INSIDE an Engine (JITDatapath,
+    incremental + delta-patch on, shadow auditor armed at sampling 1.0),
+    keeps a feeder thread pushing conntrack-churn traffic through the
+    ingestion pipeline the whole time, and storms rule adds/removes
+    against warm geometry — the long-lived-daemon steady state where every
+    update rides the sparse-delta scatter-apply path.
+
+    Reported: ``rule_add_ms``/``rule_remove_ms`` p50+p99 (the full
+    regenerate() wall time per update, host compile + device apply),
+    the span split (``engine.regen.patch`` host compile,
+    ``datapath.patch.apply`` device scatter enqueue) and
+    ``device_ready_p50_ms`` (block-until-ready on the patched verdict
+    under load). Parity: the auditor replays every finalized batch against
+    the exact revision it classified under — ``audit.mismatched_rows``
+    must be 0 (no batch classified under a torn update). A second phase
+    re-runs the cfg5 churn loop with the overlapped device-side CT GC off
+    vs armed and gates the throughput ratio.
+    """
+    import jax
+    from cilium_tpu.model.labels import Labels
+    from cilium_tpu.observe.trace import (CT_GC_SPAN, PATCH_APPLY_SPAN,
+                                          TRACER)
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.engine import Engine
+
+    if updates <= 0:
+        updates = 40 if preset == "smoke" else 120
+    n_ids = 500 if preset == "smoke" else 2000
+    n_rules = 5000 if preset == "smoke" else 50000
+    storm_pods = 8                     # warm split set the storm cycles
+    TRACER.configure(sample_rate=1.0, capacity=1 << 16)
+    TRACER.reset()
+
+    cfg = DaemonConfig(ct_capacity=1 << 14, auto_regen=False,
+                       batch_size=traffic_batch,
+                       pipeline_flush_ms=1.0,
+                       # one epoch ≈ 8 ticks: the production shape (chunks
+                       # small relative to the table), scaled to the
+                       # bench's CT capacity
+                       ct_gc_chunk_rows=1 << 11,
+                       audit_enabled=True, audit_sample_rate=1.0,
+                       audit_pool_batches=64, flowlog_mode="none",
+                       trace_sample_rate=1.0)
+    eng = Engine(cfg, datapath=JITDatapath(cfg))
+    eng.auditor.configure(sample_rate=1.0)
+
+    # -- the cfg5 world, engine-resident ------------------------------------
+    t0 = time.time()
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.0.10",), ep_id=1)
+    for i in range(n_ids):
+        ident = eng.ctx.allocator.allocate(
+            Labels.parse([f"k8s:pod=p{i}"]))
+        eng.ctx.ipcache.upsert(
+            f"172.{16 + (i >> 16)}.{(i >> 8) & 0xFF}.{i & 0xFF}/32",
+            ident.id)
+    from cilium_tpu.model.rules import parse_rule
+    base_rules = []
+    for j in range(n_rules):
+        base_rules.append(parse_rule({
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"pod": f"p{j % n_ids}"}}],
+                "toPorts": [{"ports": [
+                    {"port": str(1024 + (j % 25000)), "protocol": "TCP"}]}],
+            }]}))
+    eng.repo.add(base_rules)
+    eng.regenerate()
+    world_s = time.time() - t0
+
+    def storm_docs(pod: int, port: int, label: str):
+        return [{"endpointSelector": {"matchLabels": {"app": "web"}},
+                 "labels": [label],
+                 "ingress": [{
+                     "fromEndpoints": [{"matchLabels":
+                                        {"pod": f"p{pod}"}}],
+                     "toPorts": [{"ports": [{"port": str(port),
+                                             "protocol": "TCP"}]}]}]}]
+
+    # warm: split each storm pod's class once (ports reuse existing
+    # boundaries so no port-class splits ride along)
+    storm_ports = [1024 + 7 * k for k in range(storm_pods)]
+    for k in range(storm_pods):
+        eng.replace_policy([f"k8s:storm=w{k}"],
+                           storm_docs(k, storm_ports[k],
+                                      f"k8s:storm=w{k}"))
+        eng.regenerate()
+    patch_base = dict(eng.datapath.patch_stats)
+
+    # -- live traffic (the cfg5 churn stream through the pipeline) ----------
+    rng = np.random.default_rng(9)
+
+    def churn_batch(n):
+        b = _base_batch(n, direction=1)
+        i = rng.integers(0, n_ids, n)
+        b["src"][:, 3] = (0xAC100000 + ((i >> 8) & 0xFF) * 256
+                          + (i & 0xFF)).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = rng.integers(20000, 60000, n)
+        b["dport"][:] = (1024 + i % 25000).astype(np.int32)
+        b["tcp_flags"][:] = 0x10
+        return b
+
+    stop_traffic = threading.Event()
+    traffic_sent = [0]
+    traffic_errors = [0]
+    traffic_now = [50_000]
+
+    def feeder():
+        while not stop_traffic.is_set():
+            traffic_now[0] += 1
+            try:
+                eng.submit(churn_batch(traffic_batch),
+                           now=traffic_now[0], deadline_ms=0)
+                traffic_sent[0] += 1
+            except Exception:
+                # counted AND gated below: a feeder that stops feeding
+                # would make this an idle-engine benchmark lying about
+                # "under live traffic"
+                traffic_errors[0] += 1
+                time.sleep(0.005)
+
+    # warm the pipeline's device shapes before timing updates
+    eng.submit(churn_batch(traffic_batch), now=traffic_now[0]).result(
+        timeout=120)
+    th = threading.Thread(target=feeder, daemon=True, name="storm-feeder")
+    th.start()
+
+    # -- the storm ----------------------------------------------------------
+    add_ms, remove_ms, ready_ms = [], [], []
+    try:
+        for u in range(updates):
+            k = u % storm_pods
+            label = f"k8s:storm=w{k}"
+            adding = (u // storm_pods) % 2 == 1
+            body = storm_docs(k, storm_ports[k], label) if adding else None
+            t1 = time.time()
+            eng.replace_policy([label], body)
+            eng.regenerate()
+            dt = (time.time() - t1) * 1e3
+            (add_ms if adding else remove_ms).append(dt)
+            if u % 8 == 0:
+                t2 = time.time()
+                jax.block_until_ready(eng.active.tensors["verdict"])
+                ready_ms.append((time.time() - t2) * 1e3)
+    finally:
+        stop_traffic.set()
+        th.join(timeout=10)
+    drained = eng.drain(timeout=300)
+
+    # -- parity: drain the audit pool at sampling 1.0 -----------------------
+    for _ in range(400):
+        step = eng.audit_step(budget=128)
+        if not step or (not step.get("replayed")
+                        and not step.get("pending")):
+            break
+    audit = eng.auditor.stats()
+    patch_stats = {k: v - patch_base.get(k, 0)
+                   for k, v in eng.datapath.patch_stats.items()}
+
+    spans = TRACER.summary()
+    span_keys = ("engine.regen.patch", "engine.regen.place",
+                 PATCH_APPLY_SPAN)
+    stage_split = {k: spans[k] for k in span_keys if k in spans}
+
+    def _p(vals, q):
+        return round(float(np.percentile(np.asarray(vals), q)), 3) \
+            if vals else 0.0
+
+    # -- phase 2: overlapped CT GC on/off over the churn stream -------------
+    # cadence: one chunk tick per 16 buckets ≈ 40ms of traffic on this rig —
+    # still ~50x the production duty cycle (ct_gc_interval_s=2.0), so the
+    # measured overhead upper-bounds the real one. The sweep program is
+    # warmed first: its one-time jit compile is not a per-tick cost.
+    gc_doc = {}
+    gc_batches = 32 if preset == "smoke" else 64
+    eng.sweep_step(now=traffic_now[0])      # warm the chunk-sweep jit
+    eng.sweep_step(now=traffic_now[0])
+    for mode in ("off", "on"):
+        tps = []
+        for _w in range(3):
+            t1 = time.time()
+            for i in range(gc_batches):
+                traffic_now[0] += 1
+                eng.submit(churn_batch(traffic_batch),
+                           now=traffic_now[0])
+                if mode == "on" and i % 16 == 0:
+                    eng.sweep_step(now=traffic_now[0])
+            eng.drain(timeout=300)
+            tps.append(gc_batches * traffic_batch
+                       / max(time.time() - t1, 1e-9))
+        gc_doc[f"gc_{mode}_flows_per_sec"] = round(
+            float(np.percentile(tps, 50)), 1)
+    gc_ratio = gc_doc["gc_on_flows_per_sec"] \
+        / max(gc_doc["gc_off_flows_per_sec"], 1e-9)
+    gc_doc.update({
+        "gc_on_vs_off_ratio": round(gc_ratio, 4),
+        "reclaimed_total": getattr(eng.datapath, "_gc_reclaimed_total", 0),
+        "gc_span": TRACER.summary().get(CT_GC_SPAN),
+    })
+
+    eng.stop()
+
+    rule_add_p50 = _p(add_ms, 50)
+    apply_span = stage_split.get(PATCH_APPLY_SPAN, {})
+    gate_reasons = []
+    if audit["mismatched_rows"]:
+        gate_reasons.append(
+            f"parity: {audit['mismatched_rows']} mismatched rows at "
+            "sampling 1.0")
+    if patch_stats.get("patch_delta", 0) < updates // 4:
+        gate_reasons.append(
+            f"delta path underused: {patch_stats.get('patch_delta', 0)} "
+            f"delta patches over {updates} updates")
+    if audit["checked_rows"] == 0:
+        gate_reasons.append("auditor checked nothing")
+    if traffic_sent[0] < max(4, updates // 4):
+        gate_reasons.append(
+            f"live-traffic floor missed: only {traffic_sent[0]} batches "
+            f"fed during {updates} updates ({traffic_errors[0]} submit "
+            "errors) — the storm measured an idle engine")
+    if gc_ratio < 1.0 / BENCH_NOISE_FACTOR:
+        gate_reasons.append(
+            f"CT GC regressed churn throughput: ratio {gc_ratio:.3f}")
+    if not add_ms:
+        gate_reasons.append(
+            f"no rule adds measured over {updates} updates (the headline "
+            "metric never ran — raise --updates)")
+    elif REF_RULE_ADD_MS / rule_add_p50 < 50:
+        gate_reasons.append(
+            f"rule_add_ms {rule_add_p50} not ≥50x under the "
+            f"{REF_RULE_ADD_MS}ms reference")
+    if patch_stats.get("patch_scatter_errors", 0):
+        gate_reasons.append(
+            f"{patch_stats['patch_scatter_errors']} scatter failures "
+            "self-healed by full uploads during the storm")
+
+    if verbose:
+        print(f"# update-storm preset={preset} updates={updates} "
+              f"world={world_s:.1f}s traffic_batches={traffic_sent[0]} "
+              f"add p50={rule_add_p50}ms device-apply "
+              f"p50={apply_span.get('p50_ms')}ms "
+              f"audit checked={audit['checked_rows']} "
+              f"mism={audit['mismatched_rows']} gc_ratio={gc_ratio:.3f}",
+              file=sys.stderr)
+
+    return {
+        "metric": "live_update_storm_cfg5",
+        "value": rule_add_p50,
+        "unit": "ms",
+        # higher-is-better speedup vs the BENCH_r05-era reference
+        "vs_baseline": round(REF_RULE_ADD_MS / rule_add_p50, 1)
+        if add_ms else 0.0,
+        "baseline_rule_add_ms": REF_RULE_ADD_MS,
+        "rule_add_ms": rule_add_p50,
+        "rule_add_p99_ms": _p(add_ms, 99),
+        "rule_remove_ms": _p(remove_ms, 50),
+        "rule_remove_p99_ms": _p(remove_ms, 99),
+        "device_apply_p50_ms": apply_span.get("p50_ms", 0.0),
+        "device_apply_p99_ms": apply_span.get("p99_ms", 0.0),
+        "device_ready_p50_ms": _p(ready_ms, 50),
+        "updates": updates,
+        "traffic_batches": traffic_sent[0],
+        "traffic_errors": traffic_errors[0],
+        "traffic_batch": traffic_batch,
+        "drained": bool(drained),
+        "preset": preset,
+        "stage_split": stage_split,
+        "patch_stats": patch_stats,
+        "audit": {
+            "checked_rows": audit["checked_rows"],
+            "checked_batches": audit["checked_batches"],
+            "mismatched_rows": audit["mismatched_rows"],
+            "skipped_batches": audit["skipped_batches"],
+        },
+        "ct_gc": gc_doc,
+        "storm_gate": {
+            "failed": bool(gate_reasons),
+            **({"reasons": gate_reasons} if gate_reasons else {}),
+        },
     }
 
 
@@ -1589,6 +1887,15 @@ def main(argv=None):
     ap.add_argument("--frames", type=int, default=0,
                     help="with --ingest: frames to push (default "
                          "10k smoke / 100k full)")
+    ap.add_argument("--update-storm", action="store_true",
+                    help="live policy patching under pipelined traffic: "
+                         "rule add/remove p50/p99 with the host/device "
+                         "span split, parity-audited at sampling 1.0, "
+                         "plus the overlapped-CT-GC on/off churn "
+                         "comparison; gate failures exit 4")
+    ap.add_argument("--updates", type=int, default=0,
+                    help="with --update-storm: rule toggles to time "
+                         "(default 40 smoke / 120 full)")
     ap.add_argument("--kernels", action="store_true",
                     help="per-kernel compute-only microbench of the "
                          "classify interior (lpm / ct_probe / policy_l7 / "
@@ -1689,6 +1996,22 @@ def main(argv=None):
             if result["compare"]["failed"]:
                 rc = 4
         if result.get("fused_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
+    if args.update_storm:
+        result = update_storm_bench(preset, updates=args.updates,
+                                    verbose=args.verbose)
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("storm_gate", {}).get("failed"):
             rc = 4
         _progress["headline"] = result
         print(json.dumps(result))

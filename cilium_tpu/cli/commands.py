@@ -1224,6 +1224,47 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
         f"fails, health={h_open['state']}, probe closed breaker and "
         f"matched baseline={probe_ok}")
 
+    # -- phase 3.8: restart with CT survival (ROADMAP 3b) -------------------
+    # an established flow must keep its verdict THROUGH a daemon restart:
+    # checkpoint (versioned ct.npz), fresh engine, restore — the reply-side
+    # packet classifies ESTABLISHED from the reloaded CT where a cold
+    # engine would see NEW; the overlapped CT GC ticks cleanly after
+    state = tempfile.mkdtemp(prefix="cilium-tpu-chaos-restart-")
+    try:
+        s16, _ = parse_addr("192.168.1.10")
+        d16, _ = parse_addr("10.1.2.3")
+        syn = PacketRecord(s16, d16, 45001, 443, C.PROTO_TCP, C.TCP_SYN,
+                           False, 1, C.DIR_EGRESS)
+        ack = PacketRecord(s16, d16, 45001, 443, C.PROTO_TCP, 0x10,
+                           False, 1, C.DIR_EGRESS)
+        b = batch_from_records([syn, ack], slot_of)
+        out = eng.classify(b, now=700)
+        established = bool(out["allow"][0]) and bool(out["allow"][1])
+        ckpt.save(eng, state)
+        fresh = mk_engine()
+        restored = ckpt.restore(fresh, state)
+        ct_kept = gc_ok = False
+        if restored:
+            b2 = batch_from_records([ack],
+                                    fresh.active.snapshot.ep_slot_of)
+            out2 = fresh.classify(b2, now=705)
+            ct_kept = bool(out2["allow"][0]) and \
+                int(out2["status"][0]) == int(C.CTStatus.ESTABLISHED)
+            if hasattr(fresh.datapath, "sweep_step"):
+                gc_ok = fresh.sweep_step(now=710) is not None \
+                    and fresh.sweep_step(now=711) is not None
+            else:
+                fresh.sweep(now=710)
+                gc_ok = True
+        report.record(
+            "ct-restart",
+            established and restored is True and ct_kept and gc_ok,
+            f"flow established={established}, restored={restored}, "
+            f"reply ESTABLISHED through reloaded CT={ct_kept}, "
+            f"post-restart GC tick ok={gc_ok}")
+    finally:
+        shutil.rmtree(state, ignore_errors=True)
+
     # -- phase 4: checkpoint torn write + corruption fallback ---------------
     state = tempfile.mkdtemp(prefix="cilium-tpu-chaos-ckpt-")
     try:

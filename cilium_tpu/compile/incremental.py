@@ -65,14 +65,32 @@ class SnapshotPatch:
     """What the datapath must re-place after an incremental update. Rows are
     (slot, direction, id_class) indices into the NEW snapshot's verdict
     tensor; ``full_tensors`` lists tensors that changed shape or are too
-    small to patch (re-upload wholesale)."""
+    small to patch (re-upload wholesale).
+
+    ``delta_rows``/``delta_vals`` are the *sparse delta payload*: the same
+    rows as ``verdict_rows`` ([K, 3] int32) paired with their recomputed
+    cell values ([K, n_port_classes] uint16), emitted whenever the update
+    stayed within the delta budget and no geometry changed. A datapath can
+    scatter-apply them onto the device-resident image without ever touching
+    the host-side dense tensors — the sub-ms live-patch path. When absent
+    (geometry growth, budget exceeded), ``full_tensors`` contains
+    ``"verdict"`` and placement falls back to a whole-plane upload."""
     base_revision: int
     verdict_rows: List[Tuple[int, int, int]] = field(default_factory=list)
     full_tensors: Set[str] = field(default_factory=set)
+    delta_rows: Optional[np.ndarray] = None   # [K, 3] int32
+    delta_vals: Optional[np.ndarray] = None   # [K, n_cols] uint16
 
     @property
     def is_noop(self) -> bool:
         return not self.verdict_rows and not self.full_tensors
+
+    @property
+    def is_delta(self) -> bool:
+        """True when the verdict change ships as a sparse (rows, values)
+        delta a datapath can scatter-apply in place."""
+        return (self.delta_rows is not None
+                and "verdict" not in self.full_tensors)
 
 
 @dataclass
@@ -82,6 +100,7 @@ class UpdateStats:
     rows_recomputed: int = 0
     id_class_splits: int = 0
     port_class_splits: int = 0
+    delta_rows: int = 0                # rows shipped as a sparse delta
     fallback: Optional[str] = None     # reason a full rebuild was required
 
 
@@ -151,8 +170,22 @@ class IncrementalCompiler:
     emitted snapshot carries copies of the arrays it changed, so previously
     emitted snapshots stay immutable (revision fencing holds)."""
 
+    #: sparse-delta budget: a cycle recomputing more rows than this ships a
+    #: full verdict re-upload instead of a scatter delta (the delta's win is
+    #: O(rows) transfer; past this point the whole plane is cheaper and the
+    #: bookkeeping noise isn't)
+    DELTA_BUDGET_ROWS = 1024
+    #: overlay rebase budget: the running row overlay (rows changed since
+    #: the last dense materialization) is folded into a fresh base — one
+    #: O(image) copy — once it grows past this, so per-emission overlay
+    #: copies stay O(budget) and the amortized cost of a long churn run is
+    #: O(1) copies per update
+    REBASE_ROWS = 4096
+
     def __init__(self, repo: Repository, ctx: PolicyContext,
-                 endpoints: Sequence[Endpoint], snap: PolicySnapshot):
+                 endpoints: Sequence[Endpoint], snap: PolicySnapshot,
+                 delta_budget_rows: Optional[int] = None,
+                 rebase_rows: Optional[int] = None):
         if snap.l7_interner is None:
             raise ValueError("snapshot lacks compile context (l7_interner)")
         if repo.revision != snap.revision:
@@ -162,6 +195,11 @@ class IncrementalCompiler:
         self.repo = repo
         self.ctx = ctx
         self.base = snap
+        self.delta_budget_rows = (self.DELTA_BUDGET_ROWS
+                                  if delta_budget_rows is None
+                                  else delta_budget_rows)
+        self.rebase_rows = (self.REBASE_ROWS if rebase_rows is None
+                            else rebase_rows)
         # the seed reflects everything up to snap.revision: drain the
         # changelog so a large initial rule load cannot leave the window in
         # permanent overflow (changes_since would return None forever)
@@ -171,11 +209,25 @@ class IncrementalCompiler:
         self.identity_sig = tuple(i.id for i in ctx.allocator.all())
 
         n_eps = len(snap.ep_ids)
-        # --- working arrays (COW per update cycle) ---
-        self._verdict = snap.image.verdict
+        # --- working arrays ---
+        # The verdict image is held as (immutable base, row overlay): delta
+        # cycles write recomputed rows into ``_overlay`` only, so a 1-rule
+        # update never copies the dense image (the O(200MB) host copy that
+        # put BENCH_r05's rule add at ~620ms). The base array is NEVER
+        # mutated in place — geometry growth and rebases replace it with a
+        # fresh array — so every emitted snapshot's (base, frozen-overlay)
+        # view stays immutable (the COW/revision-fencing contract).
+        # enforced/port_table are small and keep the per-cycle COW copy.
+        self._base_verdict = snap.image.verdict
+        self._overlay: Dict[Tuple[int, int, int], np.ndarray] = {}
         self._enforced = snap.image.enforced
         self._port_table = snap.port_classes.table
         self._n_port_classes = snap.port_classes.n_classes
+        # family-range metadata is derived from the port table (an O(65k)
+        # scan per family) — cache it across emissions, invalidate only on
+        # a port-class split (the delta path's emissions are sub-ms; this
+        # scan was most of what was left)
+        self._family_ranges = snap.port_classes.family_class_ranges
         self._arrays_owned = False     # True once this cycle copied them
 
         # --- identity classes (mutable mirrors) ---
@@ -356,7 +408,7 @@ class IncrementalCompiler:
                     stats.id_class_splits += 1
                 affected_rows.add((slot, d, cls))
 
-        n_rows = self._verdict.shape[2]
+        n_rows = self._base_verdict.shape[2]
         for slot, d in whole_planes:
             for r in range(n_rows):
                 affected_rows.add((slot, d, r))
@@ -366,6 +418,19 @@ class IncrementalCompiler:
             self._recompute_row(slot, d, row)
             patch.verdict_rows.append((slot, d, row))
         stats.rows_recomputed = len(affected_rows)
+
+        # --- sparse delta payload (the device scatter-apply fast path) ---
+        # past the budget a whole-plane upload beats O(rows) scatter noise;
+        # geometry growth (splits) already forced "verdict" into
+        # full_tensors above
+        if len(affected_rows) > self.delta_budget_rows:
+            patch.full_tensors.add("verdict")
+        if patch.verdict_rows and "verdict" not in patch.full_tensors:
+            patch.delta_rows = np.asarray(patch.verdict_rows,
+                                          dtype=np.int32)
+            patch.delta_vals = np.stack(
+                [self._overlay[t] for t in patch.verdict_rows])
+            stats.delta_rows = len(patch.verdict_rows)
 
         if enforced_changed:
             self._own_arrays()
@@ -428,11 +493,28 @@ class IncrementalCompiler:
             plane.copied = False
 
     def _own_arrays(self) -> None:
+        """COW for the SMALL working arrays (enforced [n_eps,2], port_table
+        [fams,65536]). The verdict image never copies here — delta cycles
+        write the row overlay, geometry growth goes through
+        :meth:`_materialize_verdict`."""
         if not self._arrays_owned:
-            self._verdict = self._verdict.copy()
             self._enforced = self._enforced.copy()
             self._port_table = self._port_table.copy()
             self._arrays_owned = True
+
+    def _materialize_verdict(self) -> np.ndarray:
+        """Fold the row overlay into a FRESH dense verdict array and make it
+        the new base (a rebase). Called before geometry growth (column/row
+        append needs the full array) and when the overlay outgrows the
+        rebase budget. The previous base is left untouched — snapshots
+        emitted against it stay frozen."""
+        if self._overlay:
+            base = self._base_verdict.copy()
+            for (slot, d, row), vals in self._overlay.items():
+                base[slot, d, row, :] = vals
+            self._base_verdict = base
+            self._overlay = {}
+        return self._base_verdict
 
     def _cow_plane(self, slot: int, d: int) -> _PlaneState:
         plane = self.planes[(slot, d)]
@@ -467,9 +549,11 @@ class IncrementalCompiler:
             new_cls = self._n_port_classes
             self._n_port_classes += 1
             self._port_table[fam, b:hi + 1] = new_cls
-            self._verdict = np.concatenate(
-                [self._verdict, self._verdict[:, :, :, cls:cls + 1]], axis=3)
+            v = self._materialize_verdict()
+            self._base_verdict = np.concatenate(
+                [v, v[:, :, :, cls:cls + 1]], axis=3)
             patch.full_tensors.update(("verdict", "port_class"))
+            self._family_ranges = None     # re-derive at next emission
             splits += 1
         return splits
 
@@ -488,9 +572,9 @@ class IncrementalCompiler:
             rest = self._members[old_cls]
             self._representative[old_cls] = min(rest) if rest else -1
         self._representative.append(ident)
-        self._verdict = np.concatenate(
-            [self._verdict, self._verdict[:, :, old_cls:old_cls + 1, :]],
-            axis=2)
+        v = self._materialize_verdict()
+        self._base_verdict = np.concatenate(
+            [v, v[:, :, old_cls:old_cls + 1, :]], axis=2)
         patch.full_tensors.update(("verdict", "id_class_of"))
         return new_cls
 
@@ -509,10 +593,14 @@ class IncrementalCompiler:
         return keys
 
     def _recompute_row(self, slot: int, d: int, row: int) -> None:
-        self._own_arrays()
-        n_cols = self._verdict.shape[3]
+        """Resolve one verdict row from the plane's mapstate and record it
+        in the row overlay (a fresh array per row — frozen once emitted).
+        Never touches the dense base: this is the delta path's whole write
+        surface."""
+        n_cols = self._base_verdict.shape[3]
         if not self._enforced_value(slot, d):
-            self._verdict[slot, d, row, :] = C.VERDICT_MISS
+            self._overlay[(slot, d, row)] = np.full(
+                (n_cols,), C.VERDICT_MISS, dtype=np.uint16)
             return
         deny = np.zeros(n_cols, dtype=bool)
         best = np.full(n_cols, -1, dtype=np.int64)
@@ -548,28 +636,44 @@ class IncrementalCompiler:
                 sub = cols[m]
                 best[sub] = rank
                 val[sub] = cell
-        out = val.copy()
+        out = val
         out[best < 0] = C.VERDICT_MISS
         out[deny] = C.verdict_cell(C.VERDICT_DENY)
-        self._verdict[slot, d, row, :] = out
+        self._overlay[(slot, d, row)] = out
 
     # ------------------------------------------------------------------ #
     # snapshot emission
     # ------------------------------------------------------------------ #
     def _emit(self, revision: int, ct_config,
               l7_dirty: bool) -> PolicySnapshot:
+        from cilium_tpu.compile.policy_image import OverlayImage
         base = self.base
-        image = PolicyImage(verdict=self._verdict, enforced=self._enforced)
+        if self._overlay and len(self._overlay) <= self.rebase_rows:
+            # delta emission: share the immutable base + a frozen copy of
+            # the row overlay; dense access materializes lazily (the
+            # serving path scatter-applies the patch and never asks)
+            image = OverlayImage(self._base_verdict, dict(self._overlay),
+                                 self._enforced)
+        else:
+            # geometry changed, overlay outgrew the rebase budget, or
+            # nothing is pending: emit a plain dense image (one O(image)
+            # fold at most — amortized across the delta cycles since the
+            # last rebase)
+            self._materialize_verdict()
+            image = PolicyImage(verdict=self._base_verdict,
+                                enforced=self._enforced)
         id_classes = IdentityClasses(
             identity_ids=self.identity_ids,
             index_of=self.index_of,
             class_of=self._class_of.copy(),
             n_classes=self._n_classes,
             representative=np.asarray(self._representative, dtype=np.int64))
+        if self._family_ranges is None:
+            self._family_ranges = _derive_family_ranges(self._port_table)
         port_classes = PortClassTable(
             table=self._port_table,
             n_classes=self._n_port_classes,
-            family_class_ranges=_derive_family_ranges(self._port_table))
+            family_class_ranges=self._family_ranges)
         l7_tensors = build_l7_tensors(self.l7) if l7_dirty else base.l7
         policies = tuple(
             EndpointPolicy(

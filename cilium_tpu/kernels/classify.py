@@ -267,8 +267,27 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
 #: previously built a FRESH closure (and so a fresh jit cache) per call —
 #: every placement re-traced shapes the daemon had already compiled. One
 #: jitted fn per static-config key; jax's own cache then dedupes per shape.
-_FN_CACHE: dict = {}
+#:
+#: LRU-bounded: a long-lived daemon cycling many distinct static configs
+#: (probe depths, lb depths, fused toggles across restarts/tests) must not
+#: grow the memo — and the jit caches it pins — without bound. Cap
+#: overridable via CILIUM_TPU_CLASSIFY_FN_CACHE; evictions are counted and
+#: exported by Engine.render_metrics (classify_fn_cache_evictions_total).
+import collections
+import os as _os
+
+FN_CACHE_CAP = max(1, int(_os.environ.get(
+    "CILIUM_TPU_CLASSIFY_FN_CACHE", "64")))
+_FN_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _FN_LOCK = threading.Lock()
+_FN_EVICTIONS = [0]
+
+
+def fn_cache_stats() -> dict:
+    """Memo-cache observability: current size, cap, eviction count."""
+    with _FN_LOCK:
+        return {"size": len(_FN_CACHE), "cap": FN_CACHE_CAP,
+                "evictions": _FN_EVICTIONS[0]}
 
 
 def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
@@ -298,6 +317,7 @@ def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
     with _FN_LOCK:
         fn = _FN_CACHE.get(key)
         if fn is not None:
+            _FN_CACHE.move_to_end(key)     # LRU touch
             return fn
 
     def fn(tensors, ct, batch, now, world_index):
@@ -310,4 +330,12 @@ def make_classify_fn(probe_depth: int = PROBE_DEPTH, v4_only: bool = False,
                              fused_interpret=fused_interpret)
     fn = jax.jit(fn, donate_argnums=(1,) if donate_ct else ())
     with _FN_LOCK:
-        return _FN_CACHE.setdefault(key, fn)
+        cached = _FN_CACHE.get(key)
+        if cached is not None:             # lost the build race: reuse
+            _FN_CACHE.move_to_end(key)
+            return cached
+        _FN_CACHE[key] = fn
+        while len(_FN_CACHE) > FN_CACHE_CAP:
+            _FN_CACHE.popitem(last=False)  # evict least-recently-used
+            _FN_EVICTIONS[0] += 1
+        return fn

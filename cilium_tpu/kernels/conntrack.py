@@ -229,10 +229,9 @@ def ct_apply(ct, batch, slot, is_reply, contrib, now,
     }
 
 
-def ct_sweep(ct, now):
-    """Epoch GC: clear expired entries (upstream ctmap GC — SURVEY.md §2
-    "Pipelined device-side epoch sweep"). Returns (new_ct, n_reclaimed)."""
-    dead = (ct["expiry"] <= now) & (ct["expiry"] != 0)
+def _sweep_mask(ct, dead):
+    """Clear every entry under ``dead`` [cap] bool → new ct pytree (shared
+    by the whole-table sweep and the chunked epoch sweep)."""
     zero32 = jnp.uint32(0)
     new_ct = dict(ct)
     new_ct["expiry"] = jnp.where(dead, zero32, ct["expiry"])
@@ -242,4 +241,38 @@ def ct_sweep(ct, now):
     new_ct["pkts_rev"] = jnp.where(dead, zero32, ct["pkts_rev"])
     new_ct["created"] = jnp.where(dead, zero32, ct["created"])
     new_ct["rev_nat"] = jnp.where(dead, zero32, ct["rev_nat"])
-    return new_ct, dead.sum()
+    return new_ct
+
+
+def ct_sweep(ct, now):
+    """Epoch GC: clear expired entries (upstream ctmap GC — SURVEY.md §2
+    "Pipelined device-side epoch sweep"). Returns (new_ct, n_reclaimed)."""
+    dead = (ct["expiry"] <= now) & (ct["expiry"] != 0)
+    return _sweep_mask(ct, dead), dead.sum()
+
+
+def ct_sweep_chunk(ct, now, start, chunk_rows: int):
+    """One chunk of the overlapped device-side epoch sweep: clear expired
+    entries whose slot lies in ``[start, start + chunk_rows)`` (mod cap —
+    the window wraps so a cursor can advance forever) and count the whole
+    table's live occupancy in the same program.
+
+    ``chunk_rows`` is trace-time static; ``start`` is traced, so one jitted
+    program serves every cursor position. Semantics-free by construction:
+    probes and inserts already treat ``expiry <= now`` slots as
+    dead/claimable, so *when* a slot is physically cleared can never change
+    a verdict — which is exactly what lets the GC overlap live classify
+    steps instead of stopping the world.
+
+    Returns (new_ct, n_reclaimed [uint32 scalar], n_live [uint32 scalar]).
+    Both scalars are device values: the caller is expected NOT to block on
+    them in the enqueue path (the double-buffered harvest reads them a tick
+    later, when they are long since resolved)."""
+    cap = ct["expiry"].shape[0]
+    idx = jnp.arange(cap, dtype=jnp.uint32)
+    off = (idx - start.astype(jnp.uint32)) % jnp.uint32(cap)
+    in_win = off < jnp.uint32(min(chunk_rows, cap))
+    expiry = ct["expiry"]
+    dead = in_win & (expiry <= now) & (expiry != 0)
+    live = (expiry > now).sum().astype(jnp.uint32)
+    return _sweep_mask(ct, dead), dead.sum().astype(jnp.uint32), live

@@ -52,6 +52,16 @@ KERNEL_SPAN_FULL = "datapath.kernel.full_step"
 KERNEL_SPANS = (KERNEL_SPAN_LPM, KERNEL_SPAN_CT_PROBE,
                 KERNEL_SPAN_POLICY_L7, KERNEL_SPAN_FULL)
 
+#: Live-state fast-path span names (ROADMAP item 3). PATCH_APPLY_SPAN
+#: wraps the device-side scatter-apply of a sparse policy delta
+#: (JITDatapath.place_patch — the "device-apply" half of a live rule
+#: update; the host compile half rides the existing engine.regen.patch
+#: span). CT_GC_SPAN wraps one overlapped chunk-sweep enqueue
+#: (JITDatapath.sweep_step). bench.py --update-storm reads both out of the
+#: tracer summary for the artifact's host/device latency split.
+PATCH_APPLY_SPAN = "datapath.patch.apply"
+CT_GC_SPAN = "datapath.ct.gc"
+
 
 class _NullSpan:
     """Shared no-op context for unsampled events (no allocation per call)."""

@@ -112,9 +112,16 @@ def save(engine: Engine, path: str) -> None:
     """
     os.makedirs(path, exist_ok=True)
 
+    from cilium_tpu.runtime.datapath import CT_FORMAT_VERSION
     ct_path = os.path.join(path, CT_FILE)
+    # the archive is self-describing: a version stamp rides inside the npz
+    # so a CT file separated from its state.json (or restored by a newer/
+    # older build) is still validated — normalize_ct_arrays upgrades older
+    # formats it understands and refuses newer ones loudly
     _atomic_write(ct_path,
-                  lambda f: np.savez_compressed(f, **engine.ct_arrays()),
+                  lambda f: np.savez_compressed(
+                      f, __ct_format__=np.int32(CT_FORMAT_VERSION),
+                      **engine.ct_arrays()),
                   ".ct-")
     ct_sha = _sha256_file(ct_path)
 
@@ -152,6 +159,7 @@ def save(engine: Engine, path: str) -> None:
         # (upstream: fqdn cache persistence)
         "dns_cache": engine.ctx.fqdn_cache.export_state(),
         "ct_sha256": ct_sha,
+        "ct_format": CT_FORMAT_VERSION,
     }
     state["checksum"] = _state_checksum(state)
     _atomic_write(os.path.join(path, STATE_FILE),
@@ -237,7 +245,12 @@ def _read_ct(path: str, expected_sha: Optional[str] = None
                         "(established flows will re-learn)")
             return None
         with np.load(io.BytesIO(raw)) as npz:
-            return {k: npz[k] for k in npz.files}
+            arrays = {k: npz[k] for k in npz.files}
+        # normalize_ct_arrays is the ONE authority on the archive format
+        # (version stamp validation + schema upgrade); here its raise maps
+        # to the checkpoint path's warn-and-drop semantics
+        from cilium_tpu.runtime.datapath import normalize_ct_arrays
+        return normalize_ct_arrays(arrays)
     except (OSError, ValueError, BadZipFile) as e:
         log.warning("checkpoint ct.npz unreadable (%s); dropping CT", e)
         return None

@@ -47,6 +47,27 @@ class DaemonConfig:
     # repository changelog instead of full recompiles (geometry changes
     # still fall back to a full build — compile/incremental.py gates)
     incremental: bool = True
+    # --- live policy patching (the sub-ms device-resident fast path) ---
+    # delta_patch: scatter-apply sparse (rows, values) verdict deltas onto
+    # the device-resident image with donated buffers (JITDatapath) instead
+    # of round-tripping whole planes through device_put; False restores the
+    # whole-tensor re-place. patch_delta_rows gates a single patch (more
+    # touched rows → full verdict upload); patch_rebase_rows bounds the
+    # host-side row overlay before the incremental compiler folds it into
+    # a fresh dense base (one amortized O(image) copy).
+    delta_patch: bool = True
+    patch_delta_rows: int = 1024
+    patch_rebase_rows: int = 4096
+    # --- overlapped device-side CT GC (kernels/conntrack.ct_sweep_chunk) ---
+    # ct_gc_overlap: the ct-gc controller drives a double-buffered chunked
+    # epoch sweep that interleaves with classify steps (enqueue under the
+    # classify lock, reclaim counts harvested a tick later) instead of the
+    # host-blocking whole-table sweep; chunk_rows per tick (pow2), at
+    # ct_gc_interval_s cadence. Backends without device sweeps (the fake)
+    # keep the host sweep at sweep_interval_s.
+    ct_gc_overlap: bool = True
+    ct_gc_chunk_rows: int = 1 << 16
+    ct_gc_interval_s: float = 2.0
     # --- zero-copy ingestion (kernels/records.py out= + shim/feeder.py) ---
     # in-place pack into preallocated wire rings + L7 path-dict upload
     # cache (JITDatapath); False restores per-batch allocation
@@ -162,6 +183,14 @@ class DaemonConfig:
                 or self.pipeline_restart_backoff_s <= 0:
             raise ValueError("pipeline_max_restarts must be >= 0 and "
                              "pipeline_restart_backoff_s > 0")
+        if self.patch_delta_rows < 1 or self.patch_rebase_rows < 1:
+            raise ValueError(
+                "patch_delta_rows and patch_rebase_rows must be >= 1")
+        if (self.ct_gc_chunk_rows < 1
+                or self.ct_gc_chunk_rows & (self.ct_gc_chunk_rows - 1)):
+            raise ValueError("ct_gc_chunk_rows must be a power of two")
+        if self.ct_gc_interval_s <= 0:
+            raise ValueError("ct_gc_interval_s must be > 0")
         if not 0.0 <= self.trace_sample_rate <= 1.0:
             raise ValueError("trace_sample_rate must be in [0, 1]")
         if self.trace_capacity < 1:

@@ -31,7 +31,8 @@ import numpy as np
 
 from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
 from cilium_tpu.compile.snapshot import PolicySnapshot
-from cilium_tpu.observe.trace import active as active_trace
+from cilium_tpu.observe.trace import (CT_GC_SPAN, PATCH_APPLY_SPAN,
+                                      active as active_trace)
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
@@ -45,6 +46,34 @@ WIRE_RESET_CLEAN_BATCHES = 64
 
 CT_SCHEMA_KEYS = frozenset(
     ("keys", "expiry", "created", "flags", "pkts_fwd", "pkts_rev", "rev_nat"))
+
+#: ct.npz schema version written by checkpoints; normalize_ct_arrays
+#: upgrades anything older it still understands (v1 lacked rev_nat)
+CT_FORMAT_VERSION = 2
+
+
+class StalePlacement(RuntimeError):
+    """The placed handle's device buffers were donated away by a later
+    ``place_patch`` (the sub-ms delta path updates the device-resident
+    policy image in place). Raised from the classify enqueue path when a
+    caller captured the old handle before the patch landed but enqueued
+    after — the revision fence that guarantees no batch ever classifies
+    against a torn or deleted image. Callers retry with the engine's
+    current active snapshot; semantically identical to having dispatched a
+    moment later."""
+
+
+class PlacedTensors(dict):
+    """A placed snapshot handle: the device-tensor dict plus the donation
+    fence. ``dead`` flips (under the datapath's classify lock) the moment a
+    delta patch donates this handle's verdict buffer into its successor —
+    enqueueing against a dead handle raises :class:`StalePlacement` instead
+    of reading a deleted buffer."""
+    __slots__ = ("dead",)
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.dead = False
 
 
 def resolve_fused(config: DaemonConfig) -> Tuple[bool, bool]:
@@ -68,8 +97,19 @@ def normalize_ct_arrays(arrays: Dict[str, np.ndarray]
                         ) -> Dict[str, np.ndarray]:
     """Validate/upgrade a ct_layout checkpoint to the current schema —
     backend-independent (the schema belongs to the checkpoint format, not to
-    any one backend). Backfills the rev_nat column for checkpoints written
-    before service rev-NAT existed; raises on any other mismatch."""
+    any one backend). Strips the embedded format-version stamp, backfills
+    the rev_nat column for checkpoints written before service rev-NAT
+    existed (format 1), and raises on any other mismatch — including a
+    format stamp NEWER than this build understands (restoring a
+    future-format CT would silently mis-read columns; dropping it loudly
+    is the checkpoint path's fail-closed)."""
+    if "__ct_format__" in arrays:
+        arrays = dict(arrays)
+        fmt = int(np.asarray(arrays.pop("__ct_format__")).reshape(-1)[0])
+        if fmt > CT_FORMAT_VERSION:
+            raise ValueError(
+                f"CT checkpoint format {fmt} is newer than this build's "
+                f"{CT_FORMAT_VERSION}")
     if "rev_nat" not in arrays and "expiry" in arrays:
         arrays = dict(arrays)
         arrays["rev_nat"] = np.zeros_like(arrays["expiry"])
@@ -298,6 +338,30 @@ class JITDatapath(DatapathBackend):
             "upload_cache_misses": 0,
             "wire_flag_resets": 0,        # place() narrowed the wire format
         }
+        # live-patch attribution: how each place_patch applied (delta =
+        # donated device scatter; full = whole-tensor re-upload) and how
+        # often the StalePlacement fence actually fired (a caller captured
+        # the pre-patch handle and enqueued post-donation — rare, retried)
+        self.patch_stats: Dict[str, int] = {
+            "patch_delta": 0,
+            "patch_full": 0,
+            "patch_rows": 0,              # rows scatter-applied, cumulative
+            "patch_stale_fences": 0,
+            "patch_scatter_errors": 0,    # failed scatters self-healed by
+                                          # a full verdict re-upload
+        }
+        self._scatter_fn = None            # jitted donated row scatter
+        # overlapped CT GC (kernels/conntrack.ct_sweep_chunk): cursor into
+        # the slot space + the previous tick's un-materialized device
+        # scalars (the double buffer — harvested one tick later so the
+        # enqueue path never blocks on the device)
+        self._gc_fn = None
+        self._gc_chunk = 0
+        self._gc_cursor = 0
+        self._gc_epoch = 0
+        self._gc_pending = None            # (reclaimed_dev, live_dev)
+        self._gc_reclaimed_total = 0
+        self._gc_last_live = -1
 
     @property
     def pipeline_shards(self) -> int:
@@ -356,44 +420,146 @@ class JITDatapath(DatapathBackend):
         jnp = self._jnp
         self._maybe_reset_wire_flags(snap)
         if not self._sharded:
-            return {k: jnp.asarray(v) for k, v in snap.tensors().items()}
+            return PlacedTensors(
+                {k: jnp.asarray(v) for k, v in snap.tensors().items()})
         import jax
         from cilium_tpu.parallel.mesh import pad_snapshot_tensors
         tensors = pad_snapshot_tensors(snap.tensors(), self.n_rule_shards)
-        return {k: jax.device_put(
+        return PlacedTensors({k: jax.device_put(
             v, self._verdict_sharding if k == "verdict"
-            else self._repl_sharding) for k, v in tensors.items()}
+            else self._repl_sharding) for k, v in tensors.items()})
+
+    def _put_tensor(self, name, v):
+        if not self._sharded:
+            return self._jnp.asarray(v)
+        import jax
+        return jax.device_put(
+            v, self._verdict_sharding if name == "verdict"
+            else self._repl_sharding)
+
+    def _scatter_rows(self, verdict, rows, vals):
+        """Donated scatter-apply of a sparse verdict delta: the jit is
+        created once; jax's shape cache keys the (Kp, n_cols) buckets.
+        Padded rows carry an out-of-range slot index and drop."""
+        if self._scatter_fn is None:
+            import jax
+
+            def _apply(v, r, x):
+                return v.at[r[:, 0], r[:, 1], r[:, 2]].set(x, mode="drop")
+            self._scatter_fn = jax.jit(_apply, donate_argnums=(0,))
+        return self._scatter_fn(verdict, rows, vals)
+
+    #: delta row-count buckets are padded to powers of two so a policy
+    #: storm's varying patch sizes reuse a handful of scatter traces
+    #: instead of compiling one program per distinct K
+    _PATCH_PAD_SLOT = 1 << 30          # OOB slot index → mode="drop"
 
     def place_patch(self, placed, snap: PolicySnapshot, patch) -> Dict:
-        """Incremental device update (SURVEY.md §7 step 3): re-upload only
-        tensors the patch names, and apply verdict row diffs as device-side
-        index updates — a 1-rule change moves O(rows × cols) cells over the
-        link instead of the whole image."""
-        import jax
+        """Incremental device update (SURVEY.md §7 step 3 / ROADMAP item 3a):
+        re-upload only tensors the patch names; when the compiler shipped a
+        sparse (rows, values) delta, scatter-apply it onto the
+        device-resident verdict image with a DONATED buffer — the policy
+        image mutates in place on device, no host round trip of any plane.
+
+        Donation is fenced: under the classify lock the old handle is
+        marked dead before its verdict buffer is donated, so a concurrent
+        classify that captured the old handle either enqueued before the
+        patch (XLA's buffer usage-holds sequence its reads ahead of the
+        donated write) or observes ``dead`` and raises
+        :class:`StalePlacement` for the caller to retry against the new
+        active snapshot — no batch can ever classify against a torn or
+        deleted image."""
         jnp = self._jnp
         self._maybe_reset_wire_flags(snap)
-        tensors = snap.tensors()
-        if self._sharded:
-            from cilium_tpu.parallel.mesh import pad_snapshot_tensors
-            tensors = pad_snapshot_tensors(tensors, self.n_rule_shards)
+        tracer, trace_id = active_trace()
 
-        def _put(name):
-            v = tensors[name]
-            if not self._sharded:
-                return jnp.asarray(v)
-            return jax.device_put(
-                v, self._verdict_sharding if name == "verdict"
-                else self._repl_sharding)
+        new_placed = PlacedTensors(placed)
+        if patch.full_tensors:
+            # selective host materialization: only the named tensors are
+            # read (a delta-emitted snapshot's dense image stays lazy)
+            tensors = snap.tensors(only=frozenset(patch.full_tensors))
+            if self._sharded and "verdict" in tensors:
+                from cilium_tpu.parallel.mesh import pad_snapshot_tensors
+                tensors = pad_snapshot_tensors(tensors, self.n_rule_shards)
+            for name in patch.full_tensors:
+                if name in tensors:
+                    new_placed[name] = self._put_tensor(name, tensors[name])
 
-        new_placed = dict(placed)
-        for name in patch.full_tensors:
-            if name in tensors:
-                new_placed[name] = _put(name)
         if patch.verdict_rows and "verdict" not in patch.full_tensors:
-            rows = np.asarray(patch.verdict_rows, dtype=np.int32)
-            vals = tensors["verdict"][rows[:, 0], rows[:, 1], rows[:, 2]]
-            new_placed["verdict"] = placed["verdict"].at[
-                rows[:, 0], rows[:, 1], rows[:, 2]].set(jnp.asarray(vals))
+            use_delta = (self.config.delta_patch
+                         and patch.delta_rows is not None)
+            if use_delta:
+                rows_np, vals_np = patch.delta_rows, patch.delta_vals
+                k = rows_np.shape[0]
+                # pow2 padding: a storm's varying patch sizes reuse a few
+                # scatter traces; padded rows carry an OOB slot and drop
+                kp = 1 << (k - 1).bit_length() if k > 1 else 1
+                if kp != k:
+                    pad_rows = np.zeros((kp - k, 3), dtype=np.int32)
+                    pad_rows[:, 0] = self._PATCH_PAD_SLOT
+                    rows_np = np.concatenate([rows_np, pad_rows])
+                    vals_np = np.concatenate(
+                        [vals_np, np.zeros((kp - k,) + vals_np.shape[1:],
+                                           dtype=vals_np.dtype)])
+                with tracer.span(trace_id, PATCH_APPLY_SPAN, rows=k):
+                    if self._sharded:
+                        import jax
+                        rows_dev = jax.device_put(rows_np,
+                                                  self._repl_sharding)
+                        vals_dev = jax.device_put(vals_np,
+                                                  self._repl_sharding)
+                    else:
+                        rows_dev = jnp.asarray(rows_np)
+                        vals_dev = jnp.asarray(vals_np)
+                    scatter_failed = False
+                    with self._ct_lock:
+                        # the fence: dead flips atomically with the
+                        # donation — any enqueue that comes later sees it
+                        # and retries against the new active snapshot
+                        if isinstance(placed, PlacedTensors):
+                            placed.dead = True
+                        try:
+                            new_placed["verdict"] = self._scatter_rows(
+                                placed["verdict"], rows_dev, vals_dev)
+                        except Exception:
+                            # the donation may already have consumed the
+                            # old buffer AND the handle is marked dead: a
+                            # raise here would leave regenerate()'s
+                            # serve-last-good degradation pinned on a
+                            # handle every classify refuses. Self-heal
+                            # with a full verdict upload of the NEW
+                            # snapshot (outside the lock) instead.
+                            scatter_failed = True
+                    if scatter_failed:
+                        # attribution: the healed patch COUNTS AS FULL —
+                        # patch_delta must only ever mean "the donated
+                        # scatter actually ran" (the bench's delta-underuse
+                        # gate reads it as exactly that)
+                        self.patch_stats["patch_scatter_errors"] += 1
+                        self.patch_stats["patch_full"] += 1
+                        v = snap.tensors(only=frozenset(("verdict",)))
+                        if self._sharded:
+                            from cilium_tpu.parallel.mesh import \
+                                pad_snapshot_tensors
+                            v = pad_snapshot_tensors(v, self.n_rule_shards)
+                        new_placed["verdict"] = self._put_tensor(
+                            "verdict", v["verdict"])
+                    else:
+                        self.patch_stats["patch_delta"] += 1
+                        self.patch_stats["patch_rows"] += k
+            else:
+                # legacy path (delta_patch off, or a patch without the
+                # payload): functional row update from host-gathered
+                # values — no donation, the old handle stays live
+                rows = np.asarray(patch.verdict_rows, dtype=np.int32)
+                vals = snap.tensors(only=frozenset(("verdict",)))[
+                    "verdict"][rows[:, 0], rows[:, 1], rows[:, 2]]
+                new_placed["verdict"] = placed["verdict"].at[
+                    rows[:, 0], rows[:, 1], rows[:, 2]].set(
+                        jnp.asarray(vals))
+                self.patch_stats["patch_full"] += 1
+        else:
+            self.patch_stats["patch_full"] += 1
         return new_placed
 
     def classify(self, placed, snap, batch, now):
@@ -516,8 +682,11 @@ class JITDatapath(DatapathBackend):
             else:
                 dev_batch = jnp.asarray(wire)
             with self._ct_lock:
+                self._check_placed(placed)
+                # a PlacedTensors handle is a dict SUBCLASS (not a
+                # registered pytree): hand jit the plain-dict view
                 out, new_ct, counters = self._classify(
-                    placed, self._ct, dev_batch, jnp.uint32(now),
+                    dict(placed), self._ct, dev_batch, jnp.uint32(now),
                     jnp.int32(snap.world_index))
                 self._ct = new_ct
 
@@ -662,8 +831,9 @@ class JITDatapath(DatapathBackend):
             else:
                 dev_batch = jax.device_put(wire, self._batch_sharding)
             with self._ct_lock:
+                self._check_placed(placed)
                 out, new_ct, counters = self._classify(
-                    placed, self._ct, dev_batch, jnp.uint32(now),
+                    dict(placed), self._ct, dev_batch, jnp.uint32(now),
                     jnp.int32(snap.world_index))
                 self._ct = new_ct
 
@@ -680,12 +850,79 @@ class JITDatapath(DatapathBackend):
             return out_np, counters_np
         return finalize
 
+    def _check_placed(self, placed) -> None:
+        """Classify-lock-held donation fence: refuse to enqueue against a
+        handle whose buffers a delta patch donated away."""
+        if isinstance(placed, PlacedTensors) and placed.dead:
+            self.patch_stats["patch_stale_fences"] += 1
+            raise StalePlacement(
+                "placed snapshot was delta-patched in place; re-capture "
+                "the active snapshot and retry")
+
     def sweep(self, now: int) -> int:
         from cilium_tpu.kernels import conntrack as ctk
         with self._ct_lock:
             new_ct, n = ctk.ct_sweep(self._ct, self._jnp.uint32(now))
             self._ct = new_ct
         return int(n)
+
+    def sweep_step(self, now: int, chunk_rows: int) -> Dict[str, int]:
+        """One tick of the overlapped device-side epoch GC (SURVEY.md §2
+        "pipelined device-side epoch sweep"; ROADMAP item 3c).
+
+        Each tick enqueues a donated chunk sweep over
+        ``[cursor, cursor + chunk_rows)`` of the slot space — interleaving
+        with classify steps under the same lock discipline as the classify
+        dispatch itself (the enqueue is microseconds; XLA sequences the
+        donated-CT dependency chain) — and *harvests the previous tick's*
+        reclaimed/occupancy scalars, which resolved on-device while traffic
+        ran. That one-tick-late readback is the double buffer: the host
+        never blocks on sweep compute inside the enqueue path, and the
+        whole-table stop-the-world sync of the old host-driven
+        ``sweep()`` is gone.
+
+        Returns {"reclaimed", "live", "cursor", "epoch", "chunk_rows"};
+        ``live`` is -1 until the first harvest lands."""
+        import functools
+        jnp = self._jnp
+        if self._gc_fn is None or self._gc_chunk != chunk_rows:
+            import jax
+            from cilium_tpu.kernels.conntrack import ct_sweep_chunk
+            self._gc_chunk = chunk_rows
+            self._gc_fn = jax.jit(
+                functools.partial(ct_sweep_chunk, chunk_rows=chunk_rows),
+                donate_argnums=(0,))
+        # harvest the previous tick's scalars (long since resolved)
+        if self._gc_pending is not None:
+            n_dev, live_dev = self._gc_pending
+            self._gc_reclaimed_total += int(n_dev)
+            self._gc_last_live = int(live_dev)
+            reclaimed = int(n_dev)
+            self._gc_pending = None
+        else:
+            reclaimed = 0
+        cap = int(self.config.ct_capacity)
+        tracer, trace_id = active_trace()
+        with tracer.span(trace_id, CT_GC_SPAN,
+                         cursor=self._gc_cursor, chunk=chunk_rows):
+            with self._ct_lock:
+                new_ct, n_dev, live_dev = self._gc_fn(
+                    self._ct, jnp.uint32(now),
+                    jnp.uint32(self._gc_cursor))
+                self._ct = new_ct
+        self._gc_pending = (n_dev, live_dev)
+        cursor = self._gc_cursor
+        self._gc_cursor = (self._gc_cursor + chunk_rows) % cap
+        if self._gc_cursor <= cursor:
+            self._gc_epoch += 1            # wrapped: one full epoch swept
+        return {
+            "reclaimed": reclaimed,
+            "reclaimed_total": self._gc_reclaimed_total,
+            "live": self._gc_last_live,
+            "cursor": cursor,
+            "epoch": self._gc_epoch,
+            "chunk_rows": chunk_rows,
+        }
 
     def ct_stats(self, now: int) -> Dict[str, int]:
         # _ct buffers are donated into classify/sweep: reading outside the

@@ -41,7 +41,7 @@ from cilium_tpu.policy.repository import PolicyContext, Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.runtime.controller import ControllerManager, Trigger
-from cilium_tpu.runtime.datapath import DatapathBackend
+from cilium_tpu.runtime.datapath import DatapathBackend, StalePlacement
 from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.runtime.flowlog import FlowLog
 from cilium_tpu.runtime.metrics import Metrics
@@ -300,8 +300,14 @@ class Engine:
         # mid-compile must survive into the next regeneration (clearing
         # after the swap would lose that mark)
         self._dirty_event.clear()
-        # regenerations are rare and always worth a trace when tracing is on
+        # regenerations are rare and always worth a trace when tracing is
+        # on; the context makes the datapath's nested spans (the
+        # datapath.patch.apply scatter) attach to this regeneration
         trace_id = TRACER.force_sample()
+        with TRACER.context(trace_id):
+            return self._regen_traced(trace_id, force)
+
+    def _regen_traced(self, trace_id, force: bool) -> CompiledSnapshot:
         FAULTS.fire("regen.compile")
         eps = sorted(self.endpoints.values(), key=lambda e: e.ep_id)
         ct_cfg = CTConfig(self.config.ct_capacity,
@@ -357,8 +363,10 @@ class Engine:
             # seed only after placement succeeded (same staleness trap)
             from cilium_tpu.compile.incremental import \
                 IncrementalCompiler
-            self._inc = IncrementalCompiler(self.repo, self.ctx,
-                                            eps, snap)
+            self._inc = IncrementalCompiler(
+                self.repo, self.ctx, eps, snap,
+                delta_budget_rows=self.config.patch_delta_rows,
+                rebase_rows=self.config.patch_rebase_rows)
         self.repo.prune_changes(snap.revision)
         compiled = CompiledSnapshot(
             snapshot=snap, tensors=tensors,
@@ -392,19 +400,42 @@ class Engine:
         return self._active
 
     # -- datapath ---------------------------------------------------------------
+    #: StalePlacement retries before giving up: each retry blocks on the
+    #: engine lock, so one attempt per concurrently-landing patch — more
+    #: than a few in a row means something is wedged, not racing
+    _STALE_RETRIES = 4
+
+    def _await_regen(self) -> None:
+        """A StalePlacement means a delta patch donated the captured
+        handle's buffers mid-regeneration; acquiring the engine lock blocks
+        until that regeneration's atomic swap has landed, after which
+        ``self.active`` is the freshly patched snapshot."""
+        with self._lock:
+            pass
+
     def classify(self, batch: Dict[str, np.ndarray],
                  now: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Classify one batch (dict-of-arrays, kernels/records layout).
         Returns the out pytree as numpy; CT and counters update internally."""
-        active = self.active
         if now is None:
             now = int(time.time())
         trace_id = TRACER.maybe_sample()
         with TRACER.context(trace_id), \
                 TRACER.span(trace_id, "engine.classify"), \
                 self.metrics.span("classify").timer():
-            out, counters = self.datapath.classify(
-                active.tensors, active.snapshot, batch, now)
+            for attempt in range(self._STALE_RETRIES):
+                active = self.active
+                try:
+                    out, counters = self.datapath.classify(
+                        active.tensors, active.snapshot, batch, now)
+                    break
+                except StalePlacement:
+                    # a live patch landed between capturing the handle and
+                    # enqueueing: retry against the post-patch snapshot
+                    # (same as having dispatched a moment later)
+                    if attempt == self._STALE_RETRIES - 1:
+                        raise
+                    self._await_regen()
         n_valid = int(np.asarray(batch["valid"]).sum())
         self.metrics.add_batch(counters, n_valid)
         self.flowlog.append_batch(batch, out, now,
@@ -567,44 +598,57 @@ class Engine:
         snapshot's LB tables (counted ``pack_fallback_steered`` — rare and
         attributable) instead of stranding service flows' CT entries on
         the wrong shard."""
-        active = self.active
-        raw = batch.get("_ep_raw")
-        if raw is not None and raw.any():
-            # shim-fed rows carry their raw endpoint ids (raw != 0):
-            # re-map them onto THIS dispatch's snapshot — slots are
-            # re-enumerated on regen, so a harvest-time mapping can go
-            # stale in the queue and classify rows under another
-            # endpoint's policy. Unknown ids fail closed; rows without a
-            # raw id (non-shim producers coalesced into the same bucket)
-            # keep their submitted ep_slot untouched. Vectorized via the
-            # same per-snapshot LUT the feeder uses (cached; one worker
-            # thread calls this, no lock needed).
-            from cilium_tpu.shim.feeder import build_slot_lut, \
-                map_raw_slots
-            snap = active.snapshot
-            if snap is not self._remap_snap:
-                self._remap_lut = build_slot_lut(snap.ep_slot_of)
-                self._remap_snap = snap
-            slots = map_raw_slots(raw, snap.ep_slot_of, self._remap_lut)
-            has = raw != 0
-            good = has & (slots >= 0)
-            batch["ep_slot"][good] = slots[good]
-            batch["valid"] &= ~(has & (slots < 0))
-        with self.metrics.span("pipeline_dispatch").timer():
-            # a sharded pipeline's staging ring delivers rows already
-            # grouped into per-shard segments: the datapath packs them in
-            # place and ships each chip its own segment — verdicts come
-            # back in the steered geometry, un-steered per-ticket by the
-            # pipeline's finalize gather. The kwarg rides only on sharded
-            # engines so duck-typed 4-arg backends stay compatible.
-            if self._pipeline_sharded:
-                fin = self.datapath.classify_async(
-                    active.tensors, active.snapshot, batch, now,
-                    pre_steered=steer_rev is not None
-                    and steer_rev == active.revision)
-            else:
-                fin = self.datapath.classify_async(
-                    active.tensors, active.snapshot, batch, now)
+        for attempt in range(self._STALE_RETRIES):
+            active = self.active
+            raw = batch.get("_ep_raw")
+            if raw is not None and raw.any():
+                # shim-fed rows carry their raw endpoint ids (raw != 0):
+                # re-map them onto THIS dispatch's snapshot — slots are
+                # re-enumerated on regen, so a harvest-time mapping can go
+                # stale in the queue and classify rows under another
+                # endpoint's policy. Unknown ids fail closed; rows without a
+                # raw id (non-shim producers coalesced into the same bucket)
+                # keep their submitted ep_slot untouched. Vectorized via the
+                # same per-snapshot LUT the feeder uses (cached; one worker
+                # thread calls this, no lock needed). Re-runs on a
+                # StalePlacement retry: the mapping must follow the
+                # snapshot actually classifying.
+                from cilium_tpu.shim.feeder import build_slot_lut, \
+                    map_raw_slots
+                snap = active.snapshot
+                if snap is not self._remap_snap:
+                    self._remap_lut = build_slot_lut(snap.ep_slot_of)
+                    self._remap_snap = snap
+                slots = map_raw_slots(raw, snap.ep_slot_of, self._remap_lut)
+                has = raw != 0
+                good = has & (slots >= 0)
+                batch["ep_slot"][good] = slots[good]
+                batch["valid"] &= ~(has & (slots < 0))
+            try:
+                with self.metrics.span("pipeline_dispatch").timer():
+                    # a sharded pipeline's staging ring delivers rows already
+                    # grouped into per-shard segments: the datapath packs
+                    # them in place and ships each chip its own segment —
+                    # verdicts come back in the steered geometry, un-steered
+                    # per-ticket by the pipeline's finalize gather. The kwarg
+                    # rides only on sharded engines so duck-typed 4-arg
+                    # backends stay compatible.
+                    if self._pipeline_sharded:
+                        fin = self.datapath.classify_async(
+                            active.tensors, active.snapshot, batch, now,
+                            pre_steered=steer_rev is not None
+                            and steer_rev == active.revision)
+                    else:
+                        fin = self.datapath.classify_async(
+                            active.tensors, active.snapshot, batch, now)
+                break
+            except StalePlacement:
+                # a live delta patch donated the captured handle's buffers
+                # between capture and enqueue — wait out the regeneration
+                # swap and dispatch against the patched snapshot
+                if attempt == self._STALE_RETRIES - 1:
+                    raise
+                self._await_regen()
 
         def finalize():
             out, counters = fin()
@@ -661,12 +705,49 @@ class Engine:
         return fd.stats() if fd is not None else None
 
     def sweep(self, now: Optional[int] = None) -> int:
-        """CT garbage collection (upstream ctmap GC)."""
+        """CT garbage collection, host-driven whole-table mode (upstream
+        ctmap GC): blocks on the device sweep. The ct-gc controller only
+        runs this for backends without the overlapped device sweep (or
+        with ``ct_gc_overlap`` off); it remains directly callable for
+        tests/CLI."""
         if now is None:
             now = int(time.time())
         reclaimed = self.datapath.sweep(now)
         self.metrics.set_gauge("ct_last_sweep_reclaimed", reclaimed)
+        if reclaimed:
+            self.metrics.inc_counter("ct_gc_reclaimed_total", reclaimed)
+        # occupancy export: on the JIT backend ct_stats copies the expiry
+        # column host-side under the classify lock (~4MB at the default
+        # capacity) — acceptable at this path's sweep_interval_s cadence,
+        # and the reason the overlapped sweep_step derives occupancy
+        # on-device instead of ever calling this
+        st = self.datapath.ct_stats(now)
+        self.metrics.set_gauge("ct_occupancy", st["live"])
         return reclaimed
+
+    def sweep_step(self, now: Optional[int] = None) -> Optional[Dict]:
+        """One tick of the overlapped device-side CT GC (the ``ct-gc``
+        controller body on capable backends): enqueue a donated chunk sweep
+        that interleaves with live classify steps, harvest the previous
+        tick's reclaimed/occupancy scalars, and export them
+        (``ct_gc_reclaimed_total`` counter, ``ct_occupancy`` gauge). The
+        ``ct.gc`` fault point drills the controller's supervised backoff."""
+        FAULTS.fire("ct.gc")
+        if now is None:
+            now = int(time.time())
+        # GC ticks are rare: always trace one (the datapath.ct.gc span
+        # needs a context to attach to)
+        with TRACER.context(TRACER.force_sample()):
+            st = self.datapath.sweep_step(now,
+                                          self.config.ct_gc_chunk_rows)
+        if st["reclaimed"]:
+            self.metrics.inc_counter("ct_gc_reclaimed_total",
+                                     st["reclaimed"])
+        if st["live"] >= 0:
+            self.metrics.set_gauge("ct_occupancy", st["live"])
+        self.metrics.set_gauge("ct_gc_epoch", st["epoch"])
+        self.metrics.set_gauge("ct_gc_cursor", st["cursor"])
+        return st
 
     def start_background(self) -> None:
         """Start the periodic controllers and (when configured) the REST API
@@ -683,8 +764,17 @@ class Engine:
             self.controllers.update(
                 "clustermesh-sync", self._mesh.step,
                 interval=self.config.cluster_sync_interval_s)
-        self.controllers.update("ct-gc", lambda: self.sweep(),
-                                interval=self.config.sweep_interval_s)
+        if self.config.ct_gc_overlap \
+                and hasattr(self.datapath, "sweep_step"):
+            # overlapped device-side epoch GC: small donated chunk sweeps
+            # interleaved with classify at a tight cadence, reclaim counts
+            # harvested one tick late (the double buffer) — classify is
+            # never stalled behind a whole-table sweep
+            self.controllers.update("ct-gc", self.sweep_step,
+                                    interval=self.config.ct_gc_interval_s)
+        else:
+            self.controllers.update("ct-gc", lambda: self.sweep(),
+                                    interval=self.config.sweep_interval_s)
         # expired DNS names must revoke their identities (upstream: fqdn
         # cache GC controller); expire() notifies → re-materialize → regen
         self.controllers.update(
@@ -889,6 +979,32 @@ class Engine:
                             name = f"datapath_{k}_total"
                         self.metrics.inc_counter(name, d)
                         self._pack_stats_seen[k] = v
+        # live-patch attribution (delta scatter-applies vs full re-places,
+        # rows moved, stale-placement fence trips) — same delta-fold
+        patch = getattr(self.datapath, "patch_stats", None)
+        if patch:
+            with self._pack_fold_lock:
+                for k, v in patch.items():
+                    d = v - self._pack_stats_seen.get(f"patch:{k}", 0)
+                    if d:
+                        self.metrics.inc_counter(f"datapath_{k}_total", d)
+                        self._pack_stats_seen[f"patch:{k}"] = v
+        # make_classify_fn memo cache (kernels/classify): size gauge +
+        # eviction counter, folded only when the jax-backed module is
+        # actually loaded — a fake-datapath engine must stay jax-free
+        import sys as _sys
+        cls_mod = _sys.modules.get("cilium_tpu.kernels.classify")
+        if cls_mod is not None:
+            cs = cls_mod.fn_cache_stats()
+            self.metrics.set_gauge("classify_fn_cache_size", cs["size"])
+            with self._pack_fold_lock:
+                d = cs["evictions"] - self._pack_stats_seen.get(
+                    "fn_cache:evictions", 0)
+                if d:
+                    self.metrics.inc_counter(
+                        "classify_fn_cache_evictions_total", d)
+                    self._pack_stats_seen["fn_cache:evictions"] = \
+                        cs["evictions"]
         # feeder liveness/occupancy as first-class gauge families (the
         # monotone feeder_*_total counters are already incremented live by
         # the feeder itself; these are the fields that existed only in

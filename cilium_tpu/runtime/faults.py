@@ -20,6 +20,8 @@ point                   fires in
 ``audit.corrupt``       shadow-audit capture (``observe/audit.py``): a trip
                         flips the captured allow bits so the parity auditor
                         must detect the divergence
+``ct.gc``               one overlapped CT-GC tick (``Engine.sweep_step``) —
+                        trips drill the ct-gc controller's supervised backoff
 ======================  =====================================================
 
 Each point can be **armed** with one spec:
@@ -87,6 +89,10 @@ POINTS: Dict[str, str] = {
                      "the live verdicts) — simulates a datapath parity bug "
                      "so chaos drills prove the auditor detects, health "
                      "degrades, and a flight-recorder bundle freezes",
+    "ct.gc": "one tick of the overlapped device-side CT GC "
+             "(Engine.sweep_step): trips exercise the ct-gc controller's "
+             "supervised backoff — classify traffic and CT correctness "
+             "must be untouched by a wedged/failing sweep",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

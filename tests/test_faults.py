@@ -657,7 +657,7 @@ class TestChaosCLI:
         phases = {p["phase"]: p for p in doc["phases"]}
         assert set(phases) == {"regen-storm", "regen-recovery", "peer-flap",
                                "pipeline-storm", "stall-storm", "breaker",
-                               "checkpoint-corruption"}
+                               "ct-restart", "checkpoint-corruption"}
         assert all(p["ok"] for p in doc["phases"])
         assert "0 classify errors" in phases["regen-storm"]["detail"]
         assert "0 errors, 0 verdict divergences" in \
