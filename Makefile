@@ -10,7 +10,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        shim bench clean
+        ddos-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -90,7 +90,25 @@ update-smoke:
 	$(PYTEST_ENV) python bench.py --update-storm --preset smoke > /tmp/cilium_tpu_update_gate.json
 	$(PYTEST_ENV) python bench.py --update-storm --preset smoke --compare /tmp/cilium_tpu_update_gate.json > /dev/null
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke
+# Adversarial-load gate (ISSUE 10: CT exhaustion + the degradation ladder):
+# the tier-1 overload-ladder + CT-full subset — insert-when-full tail
+# eviction bit-identical across jnp/fused-interpret/bounded-oracle,
+# CT_FULL fail-closed verdicts, emergency GC hysteresis, ladder state
+# machine + priority shed + SHED-NEW harvest shed + blackbox shed split +
+# the labeled-scrape race — plus the slow flood soak (thousands of
+# pipelined submissions saturating a tiny CT with `ct.insert` faults armed
+# and the auditor at sampling 1.0: zero mismatches, checked > 0), and a
+# `bench.py --ddos` round whose gate (≥99% established-flow survival,
+# SHED-NEW reached, occupancy bounded + recovered, no post-storm
+# throughput collapse, zero parity mismatches) exits 4 on failure,
+# --compare'd against itself for the round-over-round surface.
+ddos-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_overload.py tests/test_ctfull.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_ctfull.py -q -m slow
+	$(PYTEST_ENV) python bench.py --ddos > /tmp/cilium_tpu_ddos_gate.json
+	$(PYTEST_ENV) python bench.py --ddos --compare /tmp/cilium_tpu_ddos_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

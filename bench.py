@@ -108,6 +108,9 @@ COMPARE_FIELDS = (
     ("e2e_p50_ms", -1),
     ("e2e_p99_ms", -1),
     ("pack_p50_ms", -1),
+    # --ddos artifacts: adversarial-load survival
+    ("survival_rate", +1),
+    ("legit_e2e_p99_ms", -1),
     # --update-storm artifacts: live-patch latency under pipelined traffic
     ("rule_add_ms", -1),
     ("rule_add_p99_ms", -1),
@@ -826,6 +829,402 @@ def update_storm_bench(preset: str, updates: int = 0, traffic_batch: int = 512,
         },
         "ct_gc": gc_doc,
         "storm_gate": {
+            "failed": bool(gate_reasons),
+            **({"reasons": gate_reasons} if gate_reasons else {}),
+        },
+    }
+
+
+def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
+    """cfg6: adversarial drop-storm survival over the live pipelined
+    engine (ROADMAP item 4d — the ``bpf_xdp.c`` mitigation role with real
+    drop-heavy traffic, not fault-injected hangs).
+
+    A flood of randomized-source SYNs ramps against a small CT table: a
+    40% junk slice (unknown identities → POLICY drops, the drop storm) and
+    a 60% allowed-SYN slice (an open port reachable from a /8 — the CT
+    filler that saturates the table), while a fixed population of
+    established legitimate flows keeps serving through the same pipeline.
+    The bench plays the shim feeder's role at "harvest": flood batches
+    carry ``_prio=1``, legit batches ``_prio=0``, and once the overload
+    ladder commands SHED-NEW the flood is dropped at harvest
+    (shim/feeder.shed_new_rows) without ever being submitted. Logical time
+    drives the engine's overload and ct-gc controllers deterministically
+    (manual ``overload_step``/``sweep_step`` ticks — no wall-clock
+    flakiness), with the parity auditor armed at sampling 1.0 throughout.
+
+    Reported: established-flow survival rate, legit-slice e2e p50/p99,
+    the CT occupancy trajectory (saturation → emergency-GC-bounded plateau
+    → post-storm recovery), ladder state dwell times, eviction/insert-fail
+    counters, and pre/storm/post throughput. ``ddos_gate`` fails the
+    artifact (exit 4) on: survival < 99%, any parity mismatch (or nothing
+    checked), the ladder never reaching SHED-NEW, occupancy never
+    pressuring / not stabilizing below 1.0 / not recovering below
+    ``ct_pressure_low``, no evictions (the table never actually
+    saturated), or post-storm throughput collapsing past 20% of
+    pre-storm."""
+    from cilium_tpu.pipeline.guard import OVERLOAD_SHED_NEW
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.engine import Engine
+    from cilium_tpu.shim.feeder import shed_new_rows
+
+    smoke = preset == "smoke"
+    flood_per_iter = 11 if smoke else 16
+    hold_iters = 8 if smoke else 20        # iters to hold after SHED-NEW
+    max_iters = 48 if smoke else 120
+    n_legit = batch                        # one direct-dispatch bucket
+    cap = 1 << 13
+    cfg = DaemonConfig(
+        ct_capacity=cap, auto_regen=False, batch_size=batch,
+        pipeline_flush_ms=0.5, pipeline_queue_batches=16,
+        pipeline_block_timeout_s=0.05,
+        audit_enabled=True, audit_sample_rate=1.0, audit_pool_batches=64,
+        flowlog_mode="none",
+        ct_gc_chunk_rows=1 << 10, ct_gc_emergency_chunks=8,
+        ct_gc_emergency_ttl_slash_s=56,
+        ct_pressure_high=0.8, ct_pressure_low=0.5,
+        overload_up_ticks=1, overload_down_ticks=4,
+        # the bench's iteration cadence is wall-fast (logical seconds tick
+        # faster than real ones): judge the shed rate against a threshold
+        # the flood's admission-drop + deadline-shed stream actually
+        # crosses on this rig
+        overload_shed_rate_high=15.0, overload_shed_rate_low=2.0,
+        overload_interval_s=0.1)
+    eng = Engine(cfg, datapath=JITDatapath(cfg))
+    eng.auditor.configure(sample_rate=1.0)
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.0.10",), ep_id=1)
+    # the cfg6 policy world: legit clients (172.16/16) on 443, an open
+    # port 80 reachable from 10/8 (the flood's CT-filler surface), ingress
+    # enforced — every other source drops (the storm)
+    eng.apply_policy([
+        {"endpointSelector": {"matchLabels": {"app": "web"}},
+         "ingress": [{"fromCIDR": ["172.16.0.0/16"],
+                      "toPorts": [{"ports": [
+                          {"port": "443", "protocol": "TCP"}]}]}]},
+        {"endpointSelector": {"matchLabels": {"app": "web"}},
+         "ingress": [{"fromCIDR": ["10.0.0.0/8"],
+                      "toPorts": [{"ports": [
+                          {"port": "80", "protocol": "TCP"}]}]}]},
+    ])
+    eng.regenerate()
+
+    class _BenchHarvester:
+        """The shim feeder's role, played by the bench: carries the
+        harvest-shed counter the overload controller folds into its shed
+        signal, and receives the ladder state like the real feeder."""
+        prio_shed_rows = 0
+        prio_shed_batches = 0
+        level = 0
+
+        def set_overload_state(self, level):
+            self.level = int(level)
+
+        def stats(self):
+            return {"alive": True, "pending": 0, "pool_free": 0,
+                    "prio_shed_rows": self.prio_shed_rows,
+                    "prio_shed_batches": self.prio_shed_batches,
+                    "overload_level": self.level}
+
+        def stop(self, timeout=0.0):
+            pass
+
+    harvester = _BenchHarvester()
+    eng._feeder = harvester
+
+    rng = np.random.default_rng(5)
+
+    def legit_batch():
+        b = _base_batch(n_legit, direction=1)
+        b["src"][:, 3] = (0xAC100000
+                          + np.arange(n_legit) % 250 + 1
+                          + ((np.arange(n_legit) // 250) << 8)
+                          ).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = 40000 + np.arange(n_legit)
+        b["dport"][:] = 443
+        b["tcp_flags"][:] = 0x10     # ACK → SEEN_NON_SYN → protected class
+        b["_prio"] = np.zeros((n_legit,), np.int8)
+        return b
+
+    def flood_batch():
+        b = _base_batch(batch, direction=1)
+        junk = rng.random(batch) < 0.4
+        b["src"][:, 3] = np.where(
+            junk,
+            0xCB000000 + rng.integers(0, 1 << 20, batch),   # 203.x → world
+            0x0A000000 + rng.integers(1, 1 << 24, batch),   # 10/8 → open 80
+        ).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = rng.integers(1024, 65535, batch)
+        b["dport"][:] = np.where(junk, rng.integers(1, 65535, batch), 80)
+        b["tcp_flags"][:] = 0x02                            # SYN storm
+        b["_prio"] = np.ones((batch,), np.int8)
+        return b
+
+    L = [50_000]                      # logical clock (seconds)
+    survival = {"rows": 0, "allowed": 0}
+    legit_lat_ms: list = []
+    pending_legit: list = []
+
+    def submit_legit():
+        t0 = time.monotonic()
+        try:
+            pending_legit.append((eng.submit(legit_batch(), now=L[0]), t0))
+        except Exception:
+            survival["rows"] += n_legit       # whole batch lost = 0 allowed
+
+    def pump_legit(block_s=None):
+        """Account resolved legit tickets; ``block_s`` resolves everything
+        (end of a phase), None sweeps only already-done tickets — the
+        storm loop must never serialize behind its own victims."""
+        rest = []
+        for tk, t0 in pending_legit:
+            if block_s is None and not tk.done():
+                rest.append((tk, t0))
+                continue
+            try:
+                out = tk.result(timeout=block_s if block_s is not None
+                                else 0)
+                survival["allowed"] += int(np.asarray(out["allow"]).sum())
+            except Exception:
+                pass
+            survival["rows"] += n_legit
+            legit_lat_ms.append((time.monotonic() - t0) * 1e3)
+        pending_legit[:] = rest
+
+    def run_legit(count, timeout=120.0):
+        for _ in range(count):
+            submit_legit()
+        pump_legit(block_s=timeout)
+
+    def fps_of(count):
+        t0 = time.monotonic()
+        run_legit(count)
+        return count * n_legit / max(time.monotonic() - t0, 1e-9)
+
+    # -- phase 0: establish + pre-storm throughput --------------------------
+    run_legit(2)                      # warm/compile + create entries
+    L[0] += 1
+    run_legit(2)                      # revisit: flows now ESTABLISHED
+    pre_rows0 = survival["rows"]
+    legit_lat_ms.clear()              # cold-compile warmup is not latency
+    pre_fps = fps_of(12 if smoke else 24)
+    eng.overload_step()
+
+    # -- phase 1a: CT saturation burst --------------------------------------
+    # the flood fully processed (drained per iteration): the table fills
+    # past ct_pressure_high, emergency GC arms and bounds occupancy, tail
+    # evictions + CT_FULL fails happen under the auditor — the
+    # table-exhaustion half of the scenario, before admission pressure
+    # starts refusing the flood at the door
+    occ_trajectory = []
+    flood_sent = flood_dropped = flood_harvest_shed = 0
+    max_level = 0
+    it = 0
+    storm_t0 = time.monotonic()
+    storm_rows = 0
+    sat_hold = 0
+    while it < max_iters // 2 and sat_hold < 4:
+        it += 1
+        L[0] += 1
+        for _ in range(flood_per_iter):
+            try:
+                tk = eng.submit(flood_batch(), now=L[0], deadline_ms=0)
+                flood_sent += 1
+            except Exception:
+                flood_dropped += 1
+            storm_rows += batch
+        run_legit(1, timeout=120.0)   # drain: device-bound, not ingest-bound
+        storm_rows += n_legit
+        st = eng.overload_step()
+        max_level = max(max_level, st["level"])
+        eng.sweep_step(now=L[0])
+        eng.audit_step(budget=16)
+        occ = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
+        occ_trajectory.append((it, occ))
+        if occ >= cfg.ct_pressure_high:
+            sat_hold += 1             # hold a few iters at the plateau
+
+    # -- phase 1b: the ingest storm -----------------------------------------
+    # flood submitted faster than the device drains: queue + shed signals
+    # light, the ladder escalates PRESSURE → OVERLOAD → SHED-NEW, and the
+    # bench plays the feeder's harvest-time SHED-NEW once commanded
+    shed_new_iters = 0
+    while it < max_iters and shed_new_iters < hold_iters:
+        it += 1
+        L[0] += 1
+        level = harvester.level
+        max_level = max(max_level, level)
+        for _ in range(flood_per_iter):
+            fb = flood_batch()
+            storm_rows += batch
+            if level >= OVERLOAD_SHED_NEW:
+                # the feeder's SHED-NEW behavior: drop verdicts at
+                # harvest, nothing submitted — rx-ring relief
+                shed = shed_new_rows(fb)
+                harvester.prio_shed_rows += shed
+                harvester.prio_shed_batches += 1
+                flood_harvest_shed += shed
+                continue
+            try:
+                tk = eng.submit(fb, now=L[0], deadline_ms=200)
+                if tk.dropped:
+                    flood_dropped += 1
+                else:
+                    flood_sent += 1
+            except Exception:
+                flood_dropped += 1
+        submit_legit()
+        pump_legit()                  # non-blocking: backlog must build
+        storm_rows += n_legit
+        st = eng.overload_step()
+        if st["level"] >= OVERLOAD_SHED_NEW:
+            shed_new_iters += 1
+        eng.sweep_step(now=L[0])
+        eng.audit_step(budget=16)
+        occ_trajectory.append(
+            (it, float(eng.metrics.gauges.get("ct_occupancy", 0.0))))
+    pump_legit(block_s=120.0)         # storm stragglers resolve now
+    storm_s = max(time.monotonic() - storm_t0, 1e-9)
+    storm_fps = storm_rows / storm_s
+    occ_peak = max((o for _i, o in occ_trajectory), default=0.0)
+    occ_late = occ_trajectory[-1][1] if occ_trajectory else 0.0
+
+    # -- phase 2: recovery --------------------------------------------------
+    recovered_level = None
+    for _r in range(80):
+        L[0] += 2
+        run_legit(1, timeout=60.0)
+        st = eng.overload_step()
+        eng.sweep_step(now=L[0])
+        recovered_level = st["level"]
+        occ = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
+        if recovered_level == 0 and occ <= cfg.ct_pressure_low:
+            break
+    occ_final = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
+    post_fps = fps_of(12 if smoke else 24)
+    ladder = eng.overload_status() or {}
+
+    # -- drain + audit ------------------------------------------------------
+    drained = eng.drain(timeout=120)
+    for _ in range(200):
+        step = eng.audit_step(budget=128)
+        if not step or (not step.get("replayed")
+                        and not step.get("pending")):
+            break
+    audit = eng.auditor.stats()
+    evicted = eng.metrics.ct_evicted
+    insert_fail = eng.metrics.insert_fail
+    by = eng.metrics.by_reason_dir.reshape(256, 2)
+    eng._feeder = None                # the harvester is not a real feeder
+    eng.stop()
+
+    survival_rate = survival["allowed"] / max(1, survival["rows"])
+    legit_p50 = round(float(np.percentile(legit_lat_ms, 50)), 3) \
+        if legit_lat_ms else 0.0
+    legit_p99 = round(float(np.percentile(legit_lat_ms, 99)), 3) \
+        if legit_lat_ms else 0.0
+    post_ratio = post_fps / max(pre_fps, 1e-9)
+
+    gate_reasons = []
+    if survival_rate < 0.99:
+        gate_reasons.append(
+            f"established-flow survival {survival_rate:.4f} < 0.99")
+    if audit["mismatched_rows"]:
+        gate_reasons.append(
+            f"parity: {audit['mismatched_rows']} mismatched rows at "
+            "sampling 1.0")
+    if audit["checked_rows"] == 0:
+        gate_reasons.append("auditor checked nothing")
+    if max_level < OVERLOAD_SHED_NEW:
+        gate_reasons.append(
+            f"ladder never reached SHED-NEW (max level {max_level})")
+    if occ_peak < cfg.ct_pressure_high:
+        gate_reasons.append(
+            f"flood never pressured the CT (peak occupancy {occ_peak:.3f} "
+            f"< {cfg.ct_pressure_high})")
+    if occ_late >= 0.995:
+        gate_reasons.append(
+            f"emergency GC failed to bound occupancy ({occ_late:.3f} at "
+            "storm end)")
+    if occ_final > cfg.ct_pressure_low:
+        gate_reasons.append(
+            f"occupancy did not recover below ct_pressure_low "
+            f"({occ_final:.3f} > {cfg.ct_pressure_low})")
+    if not evicted:
+        gate_reasons.append("no CT tail-evictions — the table never "
+                            "actually saturated")
+    if post_ratio < 1.0 / 1.2:
+        gate_reasons.append(
+            f"post-storm throughput collapsed: {post_fps:.0f} vs "
+            f"pre-storm {pre_fps:.0f} (ratio {post_ratio:.3f} < 1/1.2)")
+
+    if verbose:
+        print(f"# ddos preset={preset} iters={it} survival="
+              f"{survival_rate:.4f} max_level={max_level} "
+              f"occ peak/late/final={occ_peak:.3f}/{occ_late:.3f}/"
+              f"{occ_final:.3f} evicted={evicted} ct_full_fails="
+              f"{insert_fail} audit={audit['checked_rows']}/"
+              f"{audit['mismatched_rows']} fps pre/storm/post="
+              f"{pre_fps:.0f}/{storm_fps:.0f}/{post_fps:.0f}",
+              file=sys.stderr)
+
+    return {
+        "metric": "ddos_drop_storm_cfg6",
+        "value": round(survival_rate, 6),
+        "unit": "established_flow_survival",
+        "vs_baseline": round(survival_rate / 0.99, 4),
+        "survival_rate": round(survival_rate, 6),
+        "legit_rows": survival["rows"],
+        "legit_allowed": survival["allowed"],
+        "legit_e2e_p50_ms": legit_p50,
+        "legit_e2e_p99_ms": legit_p99,
+        "preset": preset,
+        "batch": batch,
+        "storm_iters": it,
+        "flood": {
+            "batches_submitted": flood_sent,
+            "batches_rejected": flood_dropped,
+            "rows_harvest_shed": flood_harvest_shed,
+            "per_iter": flood_per_iter,
+        },
+        "ladder": {
+            "max_level": max_level,
+            "recovered_level": recovered_level,
+            "dwell_s": ladder.get("dwell_s"),
+            "transitions": ladder.get("transitions"),
+            "trail": (ladder.get("trail") or [])[-8:],
+        },
+        "ct": {
+            "capacity": cap,
+            "occupancy_peak": round(occ_peak, 4),
+            "occupancy_storm_end": round(occ_late, 4),
+            "occupancy_final": round(occ_final, 4),
+            "evicted_total": int(evicted),
+            "insert_fail_total": int(insert_fail),
+            "trajectory": [(i, round(o, 4)) for i, o in
+                           occ_trajectory[:: max(1, len(occ_trajectory)
+                                                 // 32)]],
+        },
+        "drops_by_reason": {
+            str(int(r)): int(by[r].sum())
+            for r in np.nonzero(by.sum(1))[0] if r != 0},
+        "throughput": {
+            "pre_storm_fps": round(pre_fps, 1),
+            "storm_fps": round(storm_fps, 1),
+            "post_storm_fps": round(post_fps, 1),
+            "post_vs_pre_ratio": round(post_ratio, 4),
+        },
+        "audit": {
+            "checked_rows": audit["checked_rows"],
+            "checked_batches": audit["checked_batches"],
+            "mismatched_rows": audit["mismatched_rows"],
+            "skipped_batches": audit["skipped_batches"],
+        },
+        "pre_storm_rows": pre_rows0,
+        "drained": bool(drained),
+        "ddos_gate": {
             "failed": bool(gate_reasons),
             **({"reasons": gate_reasons} if gate_reasons else {}),
         },
@@ -1896,6 +2295,14 @@ def main(argv=None):
     ap.add_argument("--updates", type=int, default=0,
                     help="with --update-storm: rule toggles to time "
                          "(default 40 smoke / 120 full)")
+    ap.add_argument("--ddos", action="store_true",
+                    help="cfg6 adversarial drop-storm: a randomized-source "
+                         "SYN flood saturates a small CT table over the "
+                         "live pipelined engine while established flows "
+                         "keep serving — reports survival rate, legit e2e "
+                         "p99, CT occupancy trajectory, overload-ladder "
+                         "dwell times; auditor at sampling 1.0; gate "
+                         "failures exit 4")
     ap.add_argument("--kernels", action="store_true",
                     help="per-kernel compute-only microbench of the "
                          "classify interior (lpm / ct_probe / policy_l7 / "
@@ -2012,6 +2419,22 @@ def main(argv=None):
             if result["compare"]["failed"]:
                 rc = 4
         if result.get("storm_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
+    if args.ddos:
+        result = ddos_bench(preset, verbose=args.verbose,
+                            batch=min(batch, 256))
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("ddos_gate", {}).get("failed"):
             rc = 4
         _progress["headline"] = result
         print(json.dumps(result))

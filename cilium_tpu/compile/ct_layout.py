@@ -3,9 +3,11 @@
 
 Structure-of-arrays layout, power-of-two capacity, open addressing with
 bounded linear probing (PROBE_DEPTH slots). No dynamic memory on device —
-insert failures (all probe slots live) are counted and the packet still gets
-its policy verdict (fail-open on tracking, fail-closed on policy), and a
-device-side epoch sweep (kernels/conntrack.py) reclaims expired slots.
+a saturated probe window first tail-evicts its soonest-expiring evictable
+occupant (kernels/conntrack.ct_evictable: established TCP is protected),
+then fails the insert: counted, and the new flow classifies DROP CT_FULL
+(fail closed — exhaustion must not mint untrackable flows). A device-side
+epoch sweep (kernels/conntrack.py) reclaims expired slots.
 
 Key: 10 uint32 words — src[4] + dst[4] (16-byte normalized addresses) +
 (sport<<16|dport) + (proto<<8|open_dir). An all-zero key with expiry 0 marks

@@ -117,12 +117,15 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     """→ (out, new_ct, counters).
 
     out: allow [N] bool, reason [N] int32 (DropReason), status [N] int32
-    (CTStatus), remote_identity [N] uint32, redirect [N] bool, plus the NAT
-    rewrite columns the shim applies: svc [N] bool, nat_dst [N,4] uint32,
-    nat_dport [N] int32 (forward DNAT) and rnat [N] bool, rnat_src [N,4]
-    uint32, rnat_sport [N] int32 (reply un-DNAT).
+    (CTStatus), ct_full [N] bool (new flow denied because its CT probe
+    window stayed exhausted after the eviction round), remote_identity [N]
+    uint32, redirect [N] bool, plus the NAT rewrite columns the shim
+    applies: svc [N] bool, nat_dst [N,4] uint32, nat_dport [N] int32
+    (forward DNAT) and rnat [N] bool, rnat_src [N,4] uint32,
+    rnat_sport [N] int32 (reply un-DNAT).
     counters: by_reason_dir [COUNTER_CELLS] uint32 (reasons x directions),
-    insert_fail uint32 scalar.
+    insert_fail uint32 scalar, ct_evicted uint32 scalar (live entries
+    tail-evicted by saturated inserts).
 
     ``fused=True`` routes the interior through the Pallas kernels of
     kernels/fused.py where each stage's static geometry permits
@@ -204,10 +207,22 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
             est, reply, valid, rule_axis=rule_axis)
     reason = jnp.where(no_backend, int(C.DropReason.NO_SERVICE), reason)
 
-    # 6. CT insert for allowed new flows, then aggregate effects
+    # 6. CT insert for allowed new flows — with the insert-when-full tail
+    # eviction (kernels/conntrack docstring): slots this batch probe-hit
+    # are protected from eviction (snapshot semantics), and a flow whose
+    # window stays exhausted even after evicting fails CLOSED with the
+    # CT_FULL drop reason (an untracked flow would bypass the ladder
+    # forever once its peer replies) — then aggregate effects
     want_insert = new & allow
-    new_keys, new_created, zero_mask, slot_new, fail = \
-        ctk.ct_insert_new(ct, fwd_keys, want_insert, now, probe_depth)
+    cap = ct["expiry"].shape[0]
+    protected = jnp.zeros((cap,), dtype=bool).at[
+        jnp.where(hit, hit_slot, cap)].set(True, mode="drop")
+    new_keys, new_created, zero_mask, slot_new, fail, n_evicted = \
+        ctk.ct_insert_new(ct, fwd_keys, want_insert, now, probe_depth,
+                          evict=True, protected=protected)
+    ct_full = fail                       # fail ⊆ want_insert ⊆ new & allow
+    allow = allow & ~ct_full
+    reason = jnp.where(ct_full, int(C.DropReason.CT_FULL), reason)
     slot = jnp.where(hit, hit_slot, slot_new)
     contrib = allow & (jnp.where(hit, True, slot_new >= 0))
     new_ct = ctk.ct_apply(ct, batch, slot, reply, contrib, now,
@@ -245,12 +260,18 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     counters = {
         "by_reason_dir": by_reason_dir,
         "insert_fail": fail.sum().astype(jnp.uint32),
+        "ct_evicted": n_evicted,
     }
 
     out = {
         "allow": allow,
         "reason": reason,
         "status": status,
+        # the CT-exhaustion signal (same truth class as ``status``: a
+        # datapath-internal probe/insert fact as-of classification) — the
+        # shadow auditor captures it so oracle.replay can re-derive the
+        # CT_FULL deny without modeling the live table's occupancy
+        "ct_full": ct_full,
         "remote_identity": remote_identity,
         "redirect": redirect,
         "svc": svc & valid,

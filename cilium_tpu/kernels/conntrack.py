@@ -9,9 +9,23 @@ state; effects are applied as an order-independent aggregate (flag-bit OR via
 per-bit scatter-max, counter scatter-adds, expiry recomputed from aggregated
 flags). Inserts resolve conflicts deterministically: per probe round, the
 lowest packet index wins a free slot (scatter-min claim), duplicates of an
-inserted key adopt the entry on the next round's check. Packets whose insert
-exhausts all probe slots are counted (``insert_fail``) and still forwarded —
-tracking fails open, policy never does.
+inserted key adopt the entry on the next round's check.
+
+Insert-when-full (the adversarial-load contract, shared bit-for-bit with
+oracle.ConntrackTable's bounded mode): a new flow whose probe window holds
+no free slot performs ONE tail-eviction round — its victim is the window
+slot with the smallest expiry among *evictable* entries (``ct_evictable``:
+everything except established TCP — SYN-stage, closing, and non-TCP entries
+are fair game, so a SYN flood churns among its own entries while
+established flows survive), excluding slots claimed this batch and slots
+any packet of this batch probe-hit (snapshot semantics: a slot being
+updated by the batch is not evictable by the batch). Ties break to the
+earliest probe offset; contested victims go to the lowest packet index.
+Flows that still cannot obtain a slot fail the insert: counted
+(``insert_fail``) and classified DROP ``CT_FULL`` by the caller — under
+table exhaustion tracking fails CLOSED, the one place policy alone cannot
+answer (an untracked "established-looking" flow would bypass the ladder
+forever).
 """
 
 from __future__ import annotations
@@ -106,17 +120,38 @@ def _lifetime(proto, flags):
     return jnp.where(is_tcp, tcp_life, C.CT_LIFETIME_NONTCP).astype(jnp.uint32)
 
 
+def ct_evictable(slot_proto, flags):
+    """Which live entries an exhausted insert may tail-evict: everything
+    whose current lifetime class is NOT the established-TCP one — i.e.
+    TCP entries still in the handshake (no SEEN_NON_SYN) or closing, and
+    all non-TCP entries. One predicate, three executors (this jnp form,
+    the oracle's ``_ct_expirable``, and — by shared-core construction —
+    the fused path), so the protected class can never drift."""
+    is_tcp = slot_proto == C.PROTO_TCP
+    non_syn = (flags & jnp.uint32(C.CT_FLAG_SEEN_NON_SYN)) != 0
+    closing = (flags & jnp.uint32(C.CT_FLAG_TX_CLOSING
+                                  | C.CT_FLAG_RX_CLOSING)) != 0
+    return ~(is_tcp & non_syn & ~closing)
+
+
 def ct_insert_new(ct, keys, want_insert, now,
-                  probe_depth: int = PROBE_DEPTH):
+                  probe_depth: int = PROBE_DEPTH,
+                  evict: bool = False, protected=None):
     """Deterministic parallel insert of new flows.
 
-    Returns (new_keys, new_created, zero_mask, slot_of, fail):
+    Returns (new_keys, new_created, zero_mask, slot_of, fail, n_evicted):
     - ``zero_mask`` [cap] marks freshly-claimed slots whose value arrays
       (flags/counters) must be reset before aggregation;
     - ``slot_of`` [N] is the entry slot for every packet whose flow now has
       one (winner or adopted duplicate), else -1;
-    - ``fail`` [N] marks flows that exhausted their probe window.
-    """
+    - ``fail`` [N] marks flows that exhausted their probe window (with
+      ``evict``: even after the eviction round);
+    - ``n_evicted`` uint32 scalar: live entries tail-evicted this batch.
+
+    ``evict`` arms the insert-when-full tail eviction (module docstring);
+    ``protected`` [cap] bool marks slots the batch probe-hit (never
+    evicted — snapshot semantics demand a slot being updated by this batch
+    stays this batch's)."""
     cap = ct["expiry"].shape[0]
     mask = cap - 1
     n = keys.shape[0]
@@ -163,7 +198,48 @@ def ct_insert_new(ct, keys, want_insert, now,
         slot_of = jnp.where(adopted, s, slot_of)
         pending = pending & ~adopted
 
-    return keys_arr, created_arr, zero_mask, slot_of, pending
+    n_evicted = jnp.uint32(0)
+    if evict:
+        # tail-eviction round (batch-start state throughout): victim =
+        # the window slot with the smallest expiry among live, evictable,
+        # unclaimed, unprotected entries; ties break to the earliest probe
+        # offset (strict <), contested victims to the lowest packet index
+        exp0 = ct["expiry"]
+        slot_proto = (ct["keys"][:, 9] >> jnp.uint32(8)).astype(jnp.int32)
+        candidate = (exp0 > now) & ct_evictable(slot_proto, ct["flags"])
+        if protected is not None:
+            candidate = candidate & ~protected
+        best_s = jnp.full((n,), -1, dtype=jnp.int32)
+        best_e = jnp.full((n,), 0xFFFFFFFF, dtype=jnp.uint32)
+        for r in range(probe_depth):
+            s = (base + r) & mask
+            cand = pending & candidate[s] & ~claimed[s]
+            e = exp0[s]
+            better = cand & ((best_s < 0) | (e < best_e))
+            best_s = jnp.where(better, s, best_s)
+            best_e = jnp.where(better, e, best_e)
+        attempt = pending & (best_s >= 0)
+        scat = jnp.where(attempt, best_s, cap)
+        claim = jnp.full((cap + 1,), n, dtype=jnp.int32).at[scat].min(idx)
+        bs = jnp.where(best_s >= 0, best_s, 0)
+        winner = attempt & (claim[bs] == idx)
+        ws = jnp.where(winner, best_s, cap)
+        keys_arr = keys_arr.at[ws].set(keys, mode="drop")
+        created_arr = created_arr.at[ws].set(now, mode="drop")
+        claimed = claimed.at[ws].set(True, mode="drop")
+        zero_mask = zero_mask.at[ws].set(True, mode="drop")
+        slot_of = jnp.where(winner, best_s, slot_of)
+        pending = pending & ~winner
+        n_evicted = winner.sum().astype(jnp.uint32)
+        # adoption: duplicates of an evict-winner's key ride its new slot
+        for r in range(probe_depth):
+            s = (base + r) & mask
+            adopted = (pending & claimed[s]
+                       & jnp.all(keys_arr[s] == keys, axis=-1))
+            slot_of = jnp.where(adopted, s, slot_of)
+            pending = pending & ~adopted
+
+    return keys_arr, created_arr, zero_mask, slot_of, pending, n_evicted
 
 
 def ct_apply(ct, batch, slot, is_reply, contrib, now,
@@ -251,11 +327,18 @@ def ct_sweep(ct, now):
     return _sweep_mask(ct, dead), dead.sum()
 
 
-def ct_sweep_chunk(ct, now, start, chunk_rows: int):
+def ct_sweep_chunk(ct, now, start, chunk_rows: int, count_now=None):
     """One chunk of the overlapped device-side epoch sweep: clear expired
     entries whose slot lies in ``[start, start + chunk_rows)`` (mod cap —
     the window wraps so a cursor can advance forever) and count the whole
     table's live occupancy in the same program.
+
+    ``count_now`` (default: ``now``) is the clock the occupancy count
+    uses. Emergency GC sweeps with a slashed clock (``now`` pushed into
+    the future so short-TTL entries die early) but must keep MEASURING
+    with the real clock — a slashed count would exclude genuinely-live
+    entries the sweep has not reached, read artificially low, and flap
+    the pressure latch's exit hysteresis.
 
     ``chunk_rows`` is trace-time static; ``start`` is traced, so one jitted
     program serves every cursor position. Semantics-free by construction:
@@ -274,5 +357,6 @@ def ct_sweep_chunk(ct, now, start, chunk_rows: int):
     in_win = off < jnp.uint32(min(chunk_rows, cap))
     expiry = ct["expiry"]
     dead = in_win & (expiry <= now) & (expiry != 0)
-    live = (expiry > now).sum().astype(jnp.uint32)
+    live = (expiry > (now if count_now is None else count_now)) \
+        .sum().astype(jnp.uint32)
     return _sweep_mask(ct, dead), dead.sum().astype(jnp.uint32), live

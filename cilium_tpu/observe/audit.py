@@ -68,8 +68,11 @@ from cilium_tpu.utils import constants as C
 log = logging.getLogger("cilium_tpu.audit")
 
 #: out columns a capture snapshots (the verdict surface the replay compares;
-#: rnat fields ride along for the structural consistency check)
-AUDIT_OUT_KEYS = ("allow", "reason", "status", "remote_identity",
+#: rnat fields ride along for the structural consistency check; ct_full is
+#: the CT-exhaustion signal — same truth class as status, a table fact
+#: as-of classification that replay takes as given and may only use to
+#: EXCUSE a create it would itself demand)
+AUDIT_OUT_KEYS = ("allow", "reason", "status", "ct_full", "remote_identity",
                   "redirect", "svc", "nat_dst", "nat_dport", "rnat")
 
 #: batch columns a capture snapshots (the classify inputs; ``_``-prefixed
@@ -312,7 +315,10 @@ class ShadowAuditor:
                                      "want": False, "got": True,
                                      "why": "unknown endpoint slot"})
                 continue
-            verdict, create = oracle.replay(p, got_status)
+            got_ct_full = bool(out["ct_full"][i]) if "ct_full" in out \
+                else False
+            verdict, create = oracle.replay(p, got_status,
+                                            ct_full=got_ct_full)
             diffs = {}
             if bool(verdict.allow) != got_allow:
                 diffs["allow"] = (bool(verdict.allow), got_allow)

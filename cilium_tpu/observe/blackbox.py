@@ -51,18 +51,32 @@ log = logging.getLogger("cilium_tpu.blackbox")
 #: or half-open probe is recovery, not an anomaly. Sheds freeze only as a
 #: spike (see module docstring). Watchdog restarts and hard-fails both
 #: arrive as kind="watchdog" (the action attr distinguishes them).
+#: Overload-ladder transitions (kind="overload") and CT-emergency-GC
+#: events (kind="ct-emergency") are recorded but never freeze: they are
+#: COMMANDED degradation, the system doing its job under attack.
 FREEZE_KINDS = frozenset(("watchdog", "parity-mismatch"))
+
+#: shed reasons judged against the RELAXED spike threshold: deliberate
+#: overload shedding (admission priority eviction, harvest-time SHED-NEW,
+#: stale-at-ingest) fires at storm rate by design — freezing on it would
+#: blind the recorder exactly during the attack it should be narrating.
+#: Everything else (flush-time deadline sheds, steer_overflow) keeps the
+#: strict threshold: those spiking IS the anomaly.
+RELAXED_SHED_REASONS = frozenset(("priority", "ingest", "shed-new"))
 
 
 class FlightRecorder:
     def __init__(self, *, capacity: int = 256, verdict_batches: int = 64,
                  stats_snapshots: int = 8,
                  shed_spike: int = 64, shed_window_s: float = 5.0,
+                 shed_spike_relaxed: int = 4096,
                  span_tail: int = 128,
                  metrics: Optional[Metrics] = None,
                  tracer=None):
         if capacity < 1 or verdict_batches < 1:
             raise ValueError("capacity and verdict_batches must be >= 1")
+        if shed_spike < 1 or shed_spike_relaxed < 1:
+            raise ValueError("shed_spike thresholds must be >= 1")
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer
         self._lock = threading.Lock()
@@ -72,7 +86,13 @@ class FlightRecorder:
         self._span_tail = span_tail
         self._shed_spike = shed_spike
         self._shed_window_s = shed_window_s
+        # split by shed reason: deliberate overload sheds (priority /
+        # ingest / shed-new) spike against their own, much higher
+        # threshold so a commanded SHED-NEW storm narrates instead of
+        # freezing the recorder on every window
         self._shed_times: Deque[float] = deque(maxlen=max(1, shed_spike))
+        self._shed_times_relaxed: Deque[float] = deque(
+            maxlen=max(1, shed_spike_relaxed))
         self._frozen: Optional[Dict] = None
         self.freezes_total = 0
         self.events_total = 0
@@ -88,7 +108,7 @@ class FlightRecorder:
                 self._events.append(evt)
                 self.events_total += 1
             if kind == "shed":
-                self._note_shed(evt["mono"])
+                self._note_shed(evt["mono"], attrs.get("reason"))
                 return
             if kind in FREEZE_KINDS or \
                     (kind == "breaker" and attrs.get("new") == "open"):
@@ -97,14 +117,19 @@ class FlightRecorder:
         except Exception:   # noqa: BLE001 — the recorder must never bite
             log.exception("flight recorder event failed")
 
-    def _note_shed(self, mono: float) -> None:
-        self._shed_times.append(mono)
-        if len(self._shed_times) == self._shed_times.maxlen \
-                and mono - self._shed_times[0] <= self._shed_window_s:
+    def _note_shed(self, mono: float, reason: Optional[str]) -> None:
+        times = self._shed_times_relaxed \
+            if reason in RELAXED_SHED_REASONS else self._shed_times
+        times.append(mono)
+        if len(times) == times.maxlen \
+                and mono - times[0] <= self._shed_window_s:
             self.freeze("shed-spike", detail={
-                "sheds": len(self._shed_times),
-                "window_s": round(mono - self._shed_times[0], 3)})
-            self._shed_times.clear()
+                "sheds": len(times),
+                "reason_class": "relaxed"
+                if times is self._shed_times_relaxed else "strict",
+                "last_reason": reason,
+                "window_s": round(mono - times[0], 3)})
+            times.clear()
 
     def record_verdicts(self, out: Dict[str, np.ndarray], n_valid: int,
                         now: int) -> None:

@@ -222,11 +222,14 @@ def rehash_ct_arrays(arrays: Dict[str, np.ndarray], n_flow_shards: int,
     hash, local slot = key hash mod the per-shard table, linear probe).
 
     Checkpoint portability: an exported table's slot placement is only valid
-    for the geometry that wrote it (the oracle-backed fake packs entries
-    densely; a single-chip table hashes over the full capacity). Rehashing on
+    for the geometry that wrote it (the bounded oracle-backed fake and a
+    single-chip table hash over the FULL capacity; a sharded table hashes
+    per shard — and legacy fake exports were dense-from-0). Rehashing on
     import makes restore correct across backends and shard counts. Returns
     (new_arrays, n_dropped) — entries whose probe window is exhausted are
-    dropped (counted, like device insert_fail: tracking fails open).
+    dropped (counted; a restore-time drop means the flow re-learns as NEW
+    on its next packet — unlike a live insert exhaustion, which since the
+    insert-when-full contract fails CLOSED with DROP ``CT_FULL``).
     ``capacity`` resizes the table while rehashing (checkpoint restored into
     a backend configured with a different ct_capacity).
     """
@@ -324,6 +327,7 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
         counters = {
             "by_reason_dir": jax.lax.psum(counters["by_reason_dir"], "flows"),
             "insert_fail": jax.lax.psum(counters["insert_fail"], "flows"),
+            "ct_evicted": jax.lax.psum(counters["ct_evicted"], "flows"),
         }
         return out, new_ct, counters
 
@@ -336,10 +340,11 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                    "is_v6", "ep_slot", "direction", "http_method",
                    "http_path", "valid")}
     out_spec = {k: P("flows") for k in
-                ("allow", "reason", "status", "remote_identity", "redirect",
-                 "svc", "nat_dst", "nat_dport", "rnat", "rnat_src",
-                 "rnat_sport")}
-    counters_spec = {"by_reason_dir": P(), "insert_fail": P()}
+                ("allow", "reason", "status", "ct_full", "remote_identity",
+                 "redirect", "svc", "nat_dst", "nat_dport", "rnat",
+                 "rnat_src", "rnat_sport")}
+    counters_spec = {"by_reason_dir": P(), "insert_fail": P(),
+                     "ct_evicted": P()}
 
     def local_fn_packed(tensors, ct, wire, now, world_index):
         # device-side unpack of the local wire segment; the width dispatch

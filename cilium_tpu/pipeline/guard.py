@@ -56,6 +56,28 @@ PIPELINE_STATES: Dict[str, int] = {
 #: breaker states → ``pipeline_breaker_state`` gauge codes
 BREAKER_STATES: Dict[str, int] = {"closed": 0, "half-open": 1, "open": 2}
 
+#: overload-ladder states (the supervised degradation ladder under
+#: adversarial load — OverloadLadder below) → ``overload_state`` gauge
+#: codes. Each rung arms one more shedding behavior; see the README
+#: "Failure modes & degradation" table for the full contract.
+OVERLOAD_OK = 0
+OVERLOAD_PRESSURE = 1
+OVERLOAD_OVERLOAD = 2
+OVERLOAD_SHED_NEW = 3
+OVERLOAD_STATES: Dict[str, int] = {
+    "ok": OVERLOAD_OK, "pressure": OVERLOAD_PRESSURE,
+    "overload": OVERLOAD_OVERLOAD, "shed-new": OVERLOAD_SHED_NEW,
+}
+OVERLOAD_STATE_NAMES: Dict[int, str] = {v: k for k, v in
+                                        OVERLOAD_STATES.items()}
+
+#: priority classes the shim feeder stamps into the ``_prio`` batch column
+#: (lower = more important). Established-CT flows outrank new flows, which
+#: outrank unknown-endpoint traffic — the shedding order under PRESSURE+.
+PRIO_ESTABLISHED = 0
+PRIO_NEW = 1
+PRIO_UNKNOWN = 2
+
 
 class PipelineError(RuntimeError):
     """Base error for pipeline submissions."""
@@ -215,6 +237,132 @@ class CircuitBreaker:
                     0.0, self.cooldown_s
                     - (time.monotonic() - self._opened_mono)), 3)
             return d
+
+
+class OverloadLadder:
+    """The explicit degradation state machine under adversarial load:
+    OK → PRESSURE → OVERLOAD → SHED-NEW.
+
+    Pure mechanism (no pipeline/engine imports): the owner feeds it one
+    ``observe(queue_frac, shed_rate, ct_occupancy)`` per control interval
+    — queue occupancy fraction, sheds+admission-drops per second, CT live
+    fraction — and propagates the returned state to the shedding sites
+    (``Pipeline.set_overload_state``, ``ShimFeeder.set_overload_state``).
+
+    Mechanics: each input is a *latched* signal with per-signal hysteresis
+    (lights at its high threshold, stays lit until it falls below its low
+    threshold), and the lit count is the severity: one lit signal holds
+    PRESSURE; two-or-more lit signals keep ESCALATING — one rung per
+    ``up_ticks`` consecutive pressured intervals, all the way to SHED-NEW
+    if the pressure survives each stronger shed (requiring all three
+    would deadlock: fail-fast admission at OVERLOAD is precisely what
+    keeps the CT signal from ever lighting in an ingest-bound storm).
+    Descent is one rung per ``down_ticks`` calm intervals and
+    deliberately slow — a storm pausing for one scrape must not whiplash
+    the feeder back into full admission.
+
+    Thread-safe; ``status()`` carries per-state dwell times (the cfg6
+    bench's ladder-residency surface) and the last observed inputs."""
+
+    #: bounded transition trail for status()/debug bundles
+    MAX_TRANSITIONS = 32
+
+    def __init__(self, *, queue_high: float = 0.75, queue_low: float = 0.25,
+                 shed_high: float = 50.0, shed_low: float = 5.0,
+                 ct_high: float = 0.85, ct_low: float = 0.6,
+                 up_ticks: int = 2, down_ticks: int = 6):
+        if not (0.0 <= queue_low < queue_high <= 1.0):
+            raise ValueError("need 0 <= queue_low < queue_high <= 1")
+        if not (0.0 <= shed_low < shed_high):
+            raise ValueError("need 0 <= shed_low < shed_high")
+        if not (0.0 <= ct_low < ct_high <= 1.0):
+            raise ValueError("need 0 <= ct_low < ct_high <= 1")
+        if up_ticks < 1 or down_ticks < 1:
+            raise ValueError("up_ticks and down_ticks must be >= 1")
+        self._hi = {"queue": queue_high, "shed": shed_high, "ct": ct_high}
+        self._lo = {"queue": queue_low, "shed": shed_low, "ct": ct_low}
+        self._up_ticks = up_ticks
+        self._down_ticks = down_ticks
+        self._lock = threading.Lock()
+        self._lit = {"queue": False, "shed": False, "ct": False}
+        self._last: Dict[str, float] = {}
+        self.state = 0
+        self._up = 0
+        self._down = 0
+        self._entered_mono = time.monotonic()
+        self._dwell = [0.0, 0.0, 0.0, 0.0]
+        self.transitions = 0
+        self._trail: list = []
+
+    def _latch(self, name: str, value: float) -> bool:
+        if value >= self._hi[name]:
+            self._lit[name] = True
+        elif value <= self._lo[name]:
+            self._lit[name] = False
+        return self._lit[name]
+
+    def observe(self, queue_frac: float, shed_rate: float,
+                ct_occupancy: float) -> Tuple[int, bool]:
+        """One control interval. Returns (state, changed)."""
+        with self._lock:
+            sev = sum((self._latch("queue", queue_frac),
+                       self._latch("shed", shed_rate),
+                       self._latch("ct", ct_occupancy)))
+            self._last = {"queue_frac": round(queue_frac, 4),
+                          "shed_rate": round(shed_rate, 2),
+                          "ct_occupancy": round(ct_occupancy, 4),
+                          "severity": sev}
+            old = self.state
+            escalate = (sev > self.state
+                        or (sev >= 2 and self.state < OVERLOAD_SHED_NEW))
+            calm = sev < self.state and sev < 2
+            if escalate:
+                self._up += 1
+                self._down = 0
+                if self._up >= self._up_ticks:
+                    self._move_locked(self.state + 1)
+                    self._up = 0
+            elif calm:
+                self._down += 1
+                self._up = 0
+                if self._down >= self._down_ticks:
+                    self._move_locked(self.state - 1)
+                    self._down = 0
+            else:
+                self._up = self._down = 0
+            return self.state, self.state != old
+
+    def _move_locked(self, to: int) -> None:
+        now = time.monotonic()
+        self._dwell[self.state] += now - self._entered_mono
+        self._entered_mono = now
+        self._trail.append({"t": time.time(),
+                            "frm": OVERLOAD_STATE_NAMES[self.state],
+                            "to": OVERLOAD_STATE_NAMES[to],
+                            "inputs": dict(self._last)})
+        del self._trail[:-self.MAX_TRANSITIONS]
+        self.state = to
+        self.transitions += 1
+        log.warning("overload ladder %s -> %s (%s)",
+                    self._trail[-1]["frm"], self._trail[-1]["to"],
+                    self._last)
+
+    def status(self) -> Dict:
+        with self._lock:
+            now = time.monotonic()
+            dwell = list(self._dwell)
+            dwell[self.state] += now - self._entered_mono
+            return {
+                "state": OVERLOAD_STATE_NAMES[self.state],
+                "level": self.state,
+                "since_s": round(now - self._entered_mono, 3),
+                "dwell_s": {OVERLOAD_STATE_NAMES[i]: round(d, 3)
+                            for i, d in enumerate(dwell)},
+                "transitions": self.transitions,
+                "trail": list(self._trail),
+                "inputs": dict(self._last),
+                "lit": dict(self._lit),
+            }
 
 
 class Watchdog:

@@ -39,6 +39,16 @@ Overload protection & self-healing (the guard layer, ``pipeline/guard.py``):
   (rejected with :class:`PipelineDeadlineExceeded`, counted per reason in
   ``pipeline_shed_total{reason}``) so a backlog never burns device time on
   answers nobody is waiting for.
+- **Priority shedding** (the overload ladder's PRESSURE behavior,
+  ``pipeline/guard.OverloadLadder`` — armed via
+  :meth:`Pipeline.set_overload_state`): with the queue full, a submission
+  that outranks the worst-priority queued one displaces it
+  (``pipeline_shed_total{reason="priority"}``, FIFO-safe for everything
+  that survives) — established-flow batches are never stuck behind a
+  flood. Rank comes from the producer's ``_prio`` column (the shim
+  feeder's established/new/unknown classes); same-class traffic keeps
+  plain FIFO admission. At OVERLOAD the full queue additionally rejects
+  instantly instead of blocking producers.
 - **Circuit breaker**: consecutive dispatch/finalize failures past
   ``breaker_threshold`` open the breaker — submissions fail fast with
   :class:`PipelineUnavailable` instead of burning the per-submission retry
@@ -82,8 +92,9 @@ import numpy as np
 from cilium_tpu.kernels.records import empty_batch, reset_batch_rows
 from cilium_tpu.observe.trace import TRACER, Tracer
 from cilium_tpu.parallel.mesh import steer_rows
-from cilium_tpu.pipeline.guard import (PIPELINE_STATES, CircuitBreaker,
-                                       PipelineClosed,
+from cilium_tpu.pipeline.guard import (OVERLOAD_OVERLOAD, OVERLOAD_PRESSURE,
+                                       PIPELINE_STATES, PRIO_NEW,
+                                       CircuitBreaker, PipelineClosed,
                                        PipelineDeadlineExceeded,
                                        PipelineDrop, PipelineError,
                                        PipelineUnavailable, Watchdog)
@@ -136,6 +147,7 @@ def shard_bin_encode(shard: np.ndarray, revision: int) -> np.ndarray:
 # resolve all-invalid submissions without a device round trip
 _OUT_SPEC: Tuple[Tuple[str, type, Tuple[int, ...]], ...] = (
     ("allow", bool, ()), ("reason", np.int32, ()), ("status", np.int32, ()),
+    ("ct_full", bool, ()),
     ("remote_identity", np.int32, ()), ("redirect", bool, ()),
     ("svc", bool, ()), ("nat_dst", np.uint32, (4,)),
     ("nat_dport", np.int32, ()), ("rnat", bool, ()),
@@ -209,17 +221,31 @@ class Ticket:
         self._event.set()
 
 
+def _batch_prio(batch: Dict[str, np.ndarray]) -> int:
+    """A submission's priority class: the BEST (minimum) ``_prio`` among
+    its valid rows — one established-flow row is enough to outrank a
+    flood batch, because shedding the batch would shed that flow with it.
+    Producers without the column (control plane, tests) rank as new-flow
+    traffic."""
+    col = batch.get("_prio")
+    if col is None:
+        return PRIO_NEW
+    p = np.asarray(col)[np.asarray(batch["valid"], dtype=bool)]
+    return int(p.min()) if p.size else PRIO_NEW
+
+
 class _Sub:
     """One admitted submission riding the queue. ``valid_idx`` is computed
     lazily on the worker — the direct-dispatch fast path never needs it."""
 
-    __slots__ = ("ticket", "batch", "now")
+    __slots__ = ("ticket", "batch", "now", "prio")
 
     def __init__(self, ticket: Ticket, batch: Dict[str, np.ndarray],
-                 now: Optional[int]):
+                 now: Optional[int], prio: int = PRIO_NEW):
         self.ticket = ticket
         self.batch = batch
         self.now = now
+        self.prio = prio
 
 
 class _Slice:
@@ -391,6 +417,14 @@ class Pipeline:
         self._default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
         self._name = name
 
+        # overload-ladder level (pipeline/guard.OverloadLadder, propagated
+        # by the engine's overload controller; plain-int writes are atomic
+        # under the GIL). >= PRESSURE arms priority shedding at admission;
+        # >= OVERLOAD additionally fails admission fast (no blocking waits
+        # — a saturated queue under overload must push backpressure to the
+        # producer immediately, not park its threads)
+        self._overload_level = 0
+
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -524,6 +558,8 @@ class Pipeline:
         ticket.trace_id = self.tracer.maybe_sample()
         deadline = time.monotonic() + (
             self._block_timeout_s if timeout is None else timeout)
+        prio = _batch_prio(batch)
+        victim: Optional[_Sub] = None
         with self._lock:
             if self._closing or self._closed:
                 raise PipelineClosed("pipeline is closed")
@@ -536,13 +572,31 @@ class Pipeline:
                     f"pipeline hard-failed after {self._restarts} worker "
                     "restarts; no new submissions")
             while len(self._queue) >= self._queue_max:
+                if self._overload_level >= OVERLOAD_PRESSURE \
+                        and victim is None:
+                    # priority shedding (the degradation ladder's PRESSURE
+                    # behavior): a full queue sheds its WORST-ranked
+                    # submission in favor of a better-ranked newcomer —
+                    # established-flow batches displace flood batches
+                    # instead of queueing behind them. Same-class traffic
+                    # keeps the plain FIFO admission below.
+                    victim = self._priority_victim_locked(prio)
+                    if victim is not None:
+                        self._queue.remove(victim)
+                        self.metrics.set_gauge("pipeline_queue_depth",
+                                               len(self._queue))
+                        break
                 remaining = deadline - time.monotonic()
-                if self._admission == "drop" or remaining <= 0:
+                if self._admission == "drop" or remaining <= 0 \
+                        or self._overload_level >= OVERLOAD_OVERLOAD:
                     self.admission_drops += 1
                     self.metrics.inc_counter("pipeline_admission_drops_total")
                     ticket._reject(PipelineDrop(
                         f"queue full ({self._queue_max} batches); "
-                        f"admission={self._admission}"))
+                        f"admission={self._admission}"
+                        + (", overload fail-fast"
+                           if self._overload_level >= OVERLOAD_OVERLOAD
+                           else "")))
                     return ticket
                 self._cond.wait(min(remaining, 0.05))
                 if self._closing or self._closed:
@@ -556,11 +610,19 @@ class Pipeline:
                         "pipeline hard-failed while blocked at admission")
             ticket.seq = self._next_seq
             self._next_seq += 1
-            self._queue.append(_Sub(ticket, batch, now))
+            self._queue.append(_Sub(ticket, batch, now, prio=prio))
             self.submitted += 1
             self._outstanding += 1
             self.metrics.set_gauge("pipeline_queue_depth", len(self._queue))
             self._cond.notify_all()
+        if victim is not None:
+            # settle OUTSIDE the lock (_shed takes it); the victim is out
+            # of the queue and settles here unconditionally — a racing
+            # sweep dedupes through ticket.done()
+            self._shed(victim.ticket, "priority", PipelineDrop(
+                f"priority shed: displaced by a class-{prio} submission "
+                f"under overload state {self._overload_level} "
+                f"(seq={victim.ticket.seq}, class={victim.prio})"))
         return ticket
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -718,6 +780,8 @@ class Pipeline:
             "submitted": submitted,
             "outstanding": outstanding,
             "queue_depth": queue_depth,
+            "queue_max": self._queue_max,
+            "overload_level": self._overload_level,
             "n_shards": self._n_shards,
             **({"shard_capacity": self._seg_cap,
                 "shard_fill": pub.get("shard_fill",
@@ -762,6 +826,27 @@ class Pipeline:
         }
 
     # -- guard plumbing -------------------------------------------------------
+    def set_overload_state(self, level: int) -> None:
+        """Propagate the overload-ladder level (engine's overload
+        controller). Level semantics live in pipeline/guard.py."""
+        self._overload_level = int(level)
+        with self._lock:
+            self._cond.notify_all()   # blocked producers re-evaluate
+
+    def _priority_victim_locked(self, incoming_prio: int) -> Optional[_Sub]:
+        """Lock held: the queued submission a better-ranked newcomer may
+        displace — the worst priority class in the queue, newest first
+        (shedding the freshest flood batch preserves the most FIFO
+        history). None when nothing ranks strictly worse than the
+        newcomer."""
+        worst: Optional[_Sub] = None
+        for sub in self._queue:
+            if worst is None or sub.prio >= worst.prio:
+                worst = sub
+        if worst is not None and worst.prio > incoming_prio:
+            return worst
+        return None
+
     def _count_unavailable(self) -> None:
         with self._lock:
             self._count_unavailable_locked()

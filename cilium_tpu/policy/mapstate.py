@@ -97,11 +97,116 @@ class LookupResult:
     entry: Optional[MapStateEntry] = None
 
 
+class _OverlayEntries:
+    """Copy-on-write entry store: an immutable shared ``base`` dict plus a
+    private ``over``-ride dict and ``dead`` tombstone set. The incremental
+    compiler's per-cycle plane copy was a full dict copy per touched plane
+    (the ~1.3ms 50k-entry copy that dominated warm-geometry rule adds);
+    an overlay copy is O(dirty keys) — the base is shared by every
+    emitted snapshot and NEVER mutated, which is what keeps previously
+    emitted (frozen) snapshots immutable without paying O(entries) per
+    cycle.
+
+    Invariants: ``over`` and ``dead`` are disjoint; ``dead ⊆ base``;
+    ``_n`` is the live count. Depth never exceeds one — a copy of an
+    overlay shares the same flat base (copying the dirty sets), and
+    :meth:`MapState.overlay_copy` folds to a flat dict once the dirty set
+    outgrows its budget (amortized O(1) full copies over a churn run)."""
+
+    __slots__ = ("base", "over", "dead", "_n")
+
+    def __init__(self, base: Dict[MapStateKey, MapStateEntry],
+                 over: Optional[Dict[MapStateKey, MapStateEntry]] = None,
+                 dead: Optional[set] = None):
+        self.base = base
+        self.over = over if over is not None else {}
+        self.dead = dead if dead is not None else set()
+        self._n = (len(base) - len(self.dead)
+                   + sum(1 for k in self.over if k not in base))
+
+    def dirty(self) -> int:
+        return len(self.over) + len(self.dead)
+
+    def flatten(self) -> Dict[MapStateKey, MapStateEntry]:
+        d = {k: v for k, v in self.base.items() if k not in self.dead}
+        d.update(self.over)
+        return d
+
+    # -- mapping protocol (the subset MapState uses) --------------------------
+    def get(self, key, default=None):
+        v = self.over.get(key)
+        if v is not None:
+            return v
+        if key in self.dead:
+            return default
+        return self.base.get(key, default)
+
+    def __contains__(self, key) -> bool:
+        return key in self.over or (key not in self.dead
+                                    and key in self.base)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __setitem__(self, key, value) -> None:
+        if key not in self.over:
+            if key in self.dead:
+                self.dead.discard(key)
+                self._n += 1
+            elif key not in self.base:
+                self._n += 1
+        self.over[key] = value
+
+    def pop(self, key, default=None):
+        v = self.over.pop(key, None)
+        if v is not None:
+            self._n -= 1
+            if key in self.base:
+                self.dead.add(key)      # still shadow the base entry
+            return v
+        if key in self.dead or key not in self.base:
+            return default
+        self.dead.add(key)
+        self._n -= 1
+        return self.base[key]
+
+    def items(self):
+        for k, v in self.base.items():
+            if k not in self.dead and k not in self.over:
+                yield k, v
+        yield from self.over.items()
+
+
 class MapState:
     """Mutable builder + queryable container of MapState entries."""
 
+    #: overlay fold budget: a copy whose dirty set exceeds this flattens
+    #: into a fresh base dict (one O(entries) copy, amortized over the
+    #: delta cycles since the last fold)
+    OVERLAY_FOLD_KEYS = 4096
+
     def __init__(self):
         self._entries: Dict[MapStateKey, MapStateEntry] = {}
+
+    def overlay_copy(self, fold_budget: Optional[int] = None) -> "MapState":
+        """O(dirty-keys) copy-on-write clone: the clone shares this
+        mapstate's (flat) base read-only and takes private copies of the
+        dirty sets, so mutating the clone never disturbs this instance —
+        the incremental compiler's per-cycle plane copy. Folds back to a
+        flat dict when the dirty set outgrows ``fold_budget``."""
+        budget = self.OVERLAY_FOLD_KEYS if fold_budget is None \
+            else fold_budget
+        ms = MapState()
+        e = self._entries
+        if isinstance(e, _OverlayEntries):
+            if e.dirty() > budget:
+                ms._entries = _OverlayEntries(e.flatten())
+            else:
+                ms._entries = _OverlayEntries(e.base, dict(e.over),
+                                              set(e.dead))
+        else:
+            ms._entries = _OverlayEntries(e)
+        return ms
 
     # -- build --------------------------------------------------------------
     def add(self, key: MapStateKey, entry: MapStateEntry) -> None:

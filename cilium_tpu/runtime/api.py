@@ -179,6 +179,8 @@ def status_doc(engine: "Engine") -> Dict:
         "pipeline": engine.pipeline_stats(),
         # None until a shim feeder is attached (Engine.start_feeder)
         "feeder": engine.feeder_stats(),
+        # None until the overload controller has observed an interval
+        "overload": engine.overload_status(),
         # None until the autotune controller has run against a pipeline
         "autotune": engine.autotune_status(),
         "trace": engine.tracer.stats(),

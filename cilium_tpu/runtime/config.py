@@ -68,6 +68,18 @@ class DaemonConfig:
     ct_gc_overlap: bool = True
     ct_gc_chunk_rows: int = 1 << 16
     ct_gc_interval_s: float = 2.0
+    # --- CT pressure / emergency GC (adversarial-load survival) ---
+    # occupancy (live/capacity) above ct_pressure_high arms EMERGENCY GC:
+    # each ct-gc tick runs ct_gc_emergency_chunks chunk sweeps with the
+    # effective TTL shortened by ct_gc_emergency_ttl_slash_s (entries
+    # within that many seconds of expiry are reclaimed early — a SYN
+    # flood's 60s entries die fast, established 21600s flows are
+    # untouched); hysteresis exits below ct_pressure_low. The same
+    # thresholds feed the overload ladder's CT signal.
+    ct_pressure_high: float = 0.85
+    ct_pressure_low: float = 0.6
+    ct_gc_emergency_chunks: int = 8
+    ct_gc_emergency_ttl_slash_s: int = 45
     # --- zero-copy ingestion (kernels/records.py out= + shim/feeder.py) ---
     # in-place pack into preallocated wire rings + L7 path-dict upload
     # cache (JITDatapath); False restores per-batch allocation
@@ -97,6 +109,22 @@ class DaemonConfig:
     pipeline_stall_timeout_s: float = 30.0
     pipeline_max_restarts: int = 3      # restart budget, then hard-failed
     pipeline_restart_backoff_s: float = 0.2  # base (capped exponential)
+    # --- overload ladder (pipeline/guard.OverloadLadder; the supervised
+    # degradation state machine OK → PRESSURE → OVERLOAD → SHED-NEW) ---
+    # the `overload` controller folds queue occupancy, shed rate and CT
+    # occupancy into the ladder each interval and propagates the state to
+    # the admission queue (priority shedding) and the shim feeder
+    # (harvest-time SHED-NEW). Signals latch with per-signal hysteresis
+    # (high to light, low to clear); the ladder climbs one rung per
+    # up_ticks pressured intervals and descends one per down_ticks calm.
+    overload_enabled: bool = True
+    overload_interval_s: float = 0.5
+    overload_queue_high: float = 0.75   # queue_depth/queue_batches to light
+    overload_queue_low: float = 0.25
+    overload_shed_rate_high: float = 50.0   # sheds+admission drops per sec
+    overload_shed_rate_low: float = 5.0
+    overload_up_ticks: int = 2
+    overload_down_ticks: int = 6
     # --- api ---
     api_socket: str = ""           # unix-socket REST path ("" = disabled)
     # --- multi-host sync (clustermesh analog; runtime/clustermesh.py) ---
@@ -134,6 +162,11 @@ class DaemonConfig:
     blackbox_verdicts: int = 64      # last-N per-batch verdict summaries
     blackbox_shed_spike: int = 64    # sheds within the window that freeze
     blackbox_shed_window_s: float = 5.0
+    # deliberate-overload sheds (priority eviction, SHED-NEW, stale-at-
+    # ingest) fire at storm rate BY DESIGN: they get this relaxed spike
+    # threshold so a commanded SHED-NEW storm cannot freeze the recorder
+    # every window, while flush/steer_overflow keep the strict one above
+    blackbox_shed_spike_relaxed: int = 4096
     # --- end-to-end latency SLO (shim harvest → verdict apply) ---
     # burn threshold for ingest_e2e_slo_burn_total (+{shard=...}); 0 keeps
     # the e2e histograms exporting but disables burn counting
@@ -191,6 +224,28 @@ class DaemonConfig:
             raise ValueError("ct_gc_chunk_rows must be a power of two")
         if self.ct_gc_interval_s <= 0:
             raise ValueError("ct_gc_interval_s must be > 0")
+        if not 0.0 <= self.ct_pressure_low < self.ct_pressure_high <= 1.0:
+            raise ValueError(
+                "need 0 <= ct_pressure_low < ct_pressure_high <= 1")
+        if self.ct_gc_emergency_chunks < 1 \
+                or self.ct_gc_emergency_ttl_slash_s < 0:
+            raise ValueError("ct_gc_emergency_chunks must be >= 1 and "
+                             "ct_gc_emergency_ttl_slash_s >= 0")
+        if self.overload_interval_s <= 0:
+            raise ValueError("overload_interval_s must be > 0")
+        if not 0.0 <= self.overload_queue_low \
+                < self.overload_queue_high <= 1.0:
+            raise ValueError(
+                "need 0 <= overload_queue_low < overload_queue_high <= 1")
+        if not 0.0 <= self.overload_shed_rate_low \
+                < self.overload_shed_rate_high:
+            raise ValueError("need 0 <= overload_shed_rate_low < "
+                             "overload_shed_rate_high")
+        if self.overload_up_ticks < 1 or self.overload_down_ticks < 1:
+            raise ValueError(
+                "overload_up_ticks and overload_down_ticks must be >= 1")
+        if self.blackbox_shed_spike_relaxed < 1:
+            raise ValueError("blackbox_shed_spike_relaxed must be >= 1")
         if not 0.0 <= self.trace_sample_rate <= 1.0:
             raise ValueError("trace_sample_rate must be in [0, 1]")
         if self.trace_capacity < 1:

@@ -673,9 +673,12 @@ class JITDatapath(DatapathBackend):
                 b, snap, pooled=True, fallback_reason="shape")
         with tracer.span(trace_id, "datapath.transfer",
                          bytes=int(wire.nbytes)):
-            # chaos point: a wedged/failed host→device link (hang mode is
-            # what the pipeline watchdog drill stalls on)
+            # chaos points: a wedged/failed host→device link (hang mode is
+            # what the pipeline watchdog drill stalls on), and the CT
+            # insert phase of this dispatch (a trip rejects the batch —
+            # tickets fail closed, FIFO intact — the ddos-smoke drill)
             FAULTS.fire("datapath.transfer")
+            FAULTS.fire("ct.insert")
             if path_dict is not None:
                 dev_batch = (jnp.asarray(wire),
                              self._upload_path_dict(path_dict))
@@ -823,6 +826,7 @@ class JITDatapath(DatapathBackend):
         with tracer.span(trace_id, "datapath.transfer", bytes=nbytes,
                          shards=self.n_flow_shards):
             FAULTS.fire("datapath.transfer")
+            FAULTS.fire("ct.insert")
             if dict_batch is not None:
                 dev_batch = dict_batch       # the jit shards the columns
             elif path_dict is not None:
@@ -866,7 +870,8 @@ class JITDatapath(DatapathBackend):
             self._ct = new_ct
         return int(n)
 
-    def sweep_step(self, now: int, chunk_rows: int) -> Dict[str, int]:
+    def sweep_step(self, now: int, chunk_rows: int,
+                   ttl_slash_s: int = 0) -> Dict[str, int]:
         """One tick of the overlapped device-side epoch GC (SURVEY.md §2
         "pipelined device-side epoch sweep"; ROADMAP item 3c).
 
@@ -880,6 +885,12 @@ class JITDatapath(DatapathBackend):
         never blocks on sweep compute inside the enqueue path, and the
         whole-table stop-the-world sync of the old host-driven
         ``sweep()`` is gone.
+
+        ``ttl_slash_s`` (emergency GC) pushes the SWEEP clock that far
+        into the future — entries within that many seconds of expiry are
+        reclaimed early — while the occupancy count stays on the real
+        clock (a slashed count would read low and flap the pressure
+        latch's exit hysteresis).
 
         Returns {"reclaimed", "live", "cursor", "epoch", "chunk_rows"};
         ``live`` is -1 until the first harvest lands."""
@@ -907,8 +918,9 @@ class JITDatapath(DatapathBackend):
                          cursor=self._gc_cursor, chunk=chunk_rows):
             with self._ct_lock:
                 new_ct, n_dev, live_dev = self._gc_fn(
-                    self._ct, jnp.uint32(now),
-                    jnp.uint32(self._gc_cursor))
+                    self._ct, jnp.uint32(now + ttl_slash_s),
+                    jnp.uint32(self._gc_cursor),
+                    count_now=jnp.uint32(now))
                 self._ct = new_ct
         self._gc_pending = (n_dev, live_dev)
         cursor = self._gc_cursor
@@ -984,7 +996,14 @@ class FakeDatapath(DatapathBackend):
         # recent PLACED_KEEP (tests only assert against recent placements)
         self.placed = []
         self.placed_total = 0            # placements ever (incl. evicted)
-        self._ct_table = ConntrackTable()
+        # BOUNDED oracle table (device hash, same probe window) so the
+        # fake exhibits the device's exact CT-exhaustion semantics — at the
+        # configured capacity a saturating test sees the same tail
+        # evictions and CT_FULL denies the jnp kernel computes, slot for
+        # slot (single-chip layout; the sharded mesh's per-shard tables
+        # hash differently and are out of the fake's scope)
+        self._ct_table = ConntrackTable(capacity=self.config.ct_capacity,
+                                        probe_depth=self.config.probe_depth)
         self._oracle = None
         self._oracle_snap = None         # snapshot the cached oracle is for
         self.ct_export_truncated = 0     # entries dropped by ct_arrays()
@@ -1015,16 +1034,21 @@ class FakeDatapath(DatapathBackend):
         return tensors
 
     def classify(self, placed, snap, batch, now):
+        FAULTS.fire("ct.insert")      # same drill point as the JIT path
         with self._lock:
             oracle = self._oracle_for(snap)
             records = _records_from_batch(batch, snap.ep_ids)
             live = [p for p in records if p is not None]
+            # counter baselines BEFORE the classify mutates the table
+            evicted0 = self._ct_table.evicted
+            fail0 = self._ct_table.insert_fail
             verdicts = iter(oracle.classify_batch_snapshot(live, now))
             n = len(records)
             out = {
                 "allow": np.zeros(n, bool),
                 "reason": np.zeros(n, np.int32),
                 "status": np.zeros(n, np.int32),
+                "ct_full": np.zeros(n, bool),
                 "remote_identity": np.zeros(n, np.int32),
                 "redirect": np.zeros(n, bool),
                 "svc": np.zeros(n, bool),
@@ -1043,6 +1067,7 @@ class FakeDatapath(DatapathBackend):
                 out["allow"][i] = v.allow
                 out["reason"][i] = v.drop_reason
                 out["status"][i] = v.ct_status
+                out["ct_full"][i] = v.ct_full
                 out["remote_identity"][i] = v.remote_identity
                 out["redirect"][i] = v.redirect
                 out["svc"][i] = v.svc
@@ -1055,6 +1080,10 @@ class FakeDatapath(DatapathBackend):
                 out["rnat_sport"][i] = v.rnat_sport
                 counters["by_reason_dir"][int(v.drop_reason) * 2
                                           + p.direction] += 1
+            counters["insert_fail"] = np.uint32(
+                self._ct_table.insert_fail - fail0)
+            counters["ct_evicted"] = np.uint32(
+                self._ct_table.evicted - evicted0)
             return out, counters
 
     def sweep(self, now: int) -> int:
@@ -1072,9 +1101,10 @@ class FakeDatapath(DatapathBackend):
             }
 
     def ct_arrays(self) -> Dict[str, np.ndarray]:
-        """Oracle CT → ct_layout arrays (one entry per occupied slot, dense
-        from 0 — slot placement is NOT hash-consistent with the device
-        table; this view is for checkpoint/inspection only)."""
+        """Oracle CT → ct_layout arrays. Bounded tables (the default)
+        export each entry at its REAL hash slot — the same placement the
+        single-chip device computes; entries without one (legacy restores)
+        fall back to the old dense-from-0 layout."""
         import logging
         from cilium_tpu.kernels.records import ct_key_words
         cap = self.config.ct_capacity
@@ -1083,10 +1113,9 @@ class FakeDatapath(DatapathBackend):
             items = list(self._ct_table.entries.items())
             overflow = len(items) - cap
             if overflow > 0:
-                # the oracle dict is unbounded; the array view is not —
-                # never lose flows silently, and when forced to, drop the
-                # soonest-to-expire entries (deterministic, not
-                # insertion-order accident)
+                # a bounded table can never overflow; an unbounded legacy
+                # dict can — never lose flows silently, and when forced
+                # to, drop the soonest-to-expire (deterministic)
                 self.ct_export_truncated += overflow
                 logging.getLogger("cilium_tpu.datapath").warning(
                     "FakeDatapath.ct_arrays: %d CT entries exceed "
@@ -1094,7 +1123,9 @@ class FakeDatapath(DatapathBackend):
                     "the export", overflow, cap)
                 items.sort(key=lambda kv: kv[1].expiry, reverse=True)
                 items = items[:cap]
-        for slot, (key, e) in enumerate(items):
+        hash_slots = all(0 <= e.slot < cap for _k, e in items)
+        for dense, (key, e) in enumerate(items):
+            slot = e.slot if hash_slots else dense
             src, dst, sport, dport, proto, d = key
             one = {
                 "src": np.frombuffer(src, dtype=">u4").reshape(1, 4),
@@ -1112,22 +1143,51 @@ class FakeDatapath(DatapathBackend):
         return arrays
 
     def load_ct_arrays(self, arrays: Dict[str, np.ndarray]) -> None:
-        """ct_layout arrays → oracle CT entries (inverse of ct_arrays)."""
-        from oracle import CTEntry
+        """ct_layout arrays → oracle CT entries (inverse of ct_arrays).
+        Entries re-place into this table's hash layout (the imported
+        arrays may come from a different shard count or a dense legacy
+        export); entries whose probe window is already full drop with a
+        warning — the same contract as the JIT backend's rehash."""
+        import logging
+        from oracle import ConntrackTable, CTEntry
         from cilium_tpu.utils.ip import words_to_addr
         arrays = normalize_ct_arrays(arrays)   # validate BEFORE clearing
+        cap = self.config.ct_capacity
+        pd = self.config.probe_depth
         with self._lock:
-            self._ct_table.entries.clear()
+            table = ConntrackTable(capacity=cap, probe_depth=pd)
             expiry = arrays["expiry"]
+            loaded = []
             for slot in np.nonzero(expiry > 0)[0]:
                 w = arrays["keys"][slot]
                 key = (words_to_addr(w[0:4]), words_to_addr(w[4:8]),
                        int(w[8]) >> 16, int(w[8]) & 0xFFFF,
                        int(w[9]) >> 8, int(w[9]) & 0xFF)
-                self._ct_table.entries[key] = CTEntry(
+                loaded.append((key, CTEntry(
                     expiry=int(expiry[slot]),
                     created=int(arrays["created"][slot]),
                     flags=int(arrays["flags"][slot]),
                     pkts_fwd=int(arrays["pkts_fwd"][slot]),
                     pkts_rev=int(arrays["pkts_rev"][slot]),
-                    rev_nat=int(arrays["rev_nat"][slot]))
+                    rev_nat=int(arrays["rev_nat"][slot]))))
+            dropped = 0
+            if loaded:
+                bases = table.base_slots([k for k, _e in loaded])
+                for (key, entry), base in zip(loaded, bases):
+                    placed = False
+                    for r in range(pd):
+                        s = (int(base) + r) % cap
+                        if table._slots[s] is None:     # noqa: SLF001
+                            table.install(key, entry, s)
+                            placed = True
+                            break
+                    if not placed:
+                        dropped += 1
+            self._ct_table = table
+            # the cached oracle closed over the old table object
+            self._oracle = None
+            self._oracle_snap = None
+            if dropped:
+                logging.getLogger("cilium_tpu.datapath").warning(
+                    "FakeDatapath.load_ct_arrays: %d entries dropped "
+                    "(probe window exhausted during re-place)", dropped)

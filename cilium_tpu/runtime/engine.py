@@ -111,6 +111,7 @@ class Engine:
             verdict_batches=self.config.blackbox_verdicts,
             shed_spike=self.config.blackbox_shed_spike,
             shed_window_s=self.config.blackbox_shed_window_s,
+            shed_spike_relaxed=self.config.blackbox_shed_spike_relaxed,
             metrics=self.metrics, tracer=TRACER)
         self.auditor = ShadowAuditor(
             sample_rate=self.config.audit_sample_rate
@@ -148,6 +149,14 @@ class Engine:
         self._pack_fold_lock = threading.Lock()     # concurrent scrapes
         self._remap_snap = None    # dispatch-time slot-LUT cache key
         self._remap_lut: Optional[np.ndarray] = None
+        # overload ladder (pipeline/guard.OverloadLadder; the `overload`
+        # controller feeds it) + shed-rate bookkeeping for its shed signal
+        self._overload = None
+        self._overload_shed_prev = 0
+        self._overload_shed_t: Optional[float] = None
+        # CT emergency-GC latch (hysteresis: enters at ct_pressure_high,
+        # exits at ct_pressure_low; armed by sweep()/sweep_step())
+        self._ct_emergency = False
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -697,22 +706,48 @@ class Engine:
                 # staging ring's flush-time scatter is a copy, not a
                 # re-hash — the feeder IS the software RSS
                 n_shards=getattr(self.datapath, "pipeline_shards", 1),
-                metrics=self.metrics, tracer=self.tracer).start()
+                metrics=self.metrics, tracer=self.tracer,
+                # SHED-NEW harvest drops narrate to the flight recorder
+                # (the relaxed shed-spike class) like pipeline sheds do
+                event_sink=self._pipeline_event).start()
             return self._feeder
 
     def feeder_stats(self) -> Optional[Dict]:
         fd = self._feeder
         return fd.stats() if fd is not None else None
 
+    def _ct_pressure_update(self, occ_frac: float) -> None:
+        """Hysteresis latch for the CT emergency-GC mode: enter above
+        ``ct_pressure_high``, exit below ``ct_pressure_low``. Transitions
+        are gauged, counted and narrated to the flight recorder (recorded,
+        never frozen — commanded degradation is the system working)."""
+        cfg = self.config
+        if not self._ct_emergency and occ_frac >= cfg.ct_pressure_high:
+            self._ct_emergency = True
+            self.metrics.set_gauge("ct_emergency_gc", 1)
+            self.metrics.inc_counter("ct_emergency_entries_total")
+            self.blackbox.record_event("ct-emergency", action="enter",
+                                       occupancy=round(occ_frac, 4))
+        elif self._ct_emergency and occ_frac <= cfg.ct_pressure_low:
+            self._ct_emergency = False
+            self.metrics.set_gauge("ct_emergency_gc", 0)
+            self.blackbox.record_event("ct-emergency", action="exit",
+                                       occupancy=round(occ_frac, 4))
+
     def sweep(self, now: Optional[int] = None) -> int:
         """CT garbage collection, host-driven whole-table mode (upstream
         ctmap GC): blocks on the device sweep. The ct-gc controller only
         runs this for backends without the overlapped device sweep (or
         with ``ct_gc_overlap`` off); it remains directly callable for
-        tests/CLI."""
+        tests/CLI. In emergency mode (occupancy latched above
+        ``ct_pressure_high``) the sweep runs with the effective TTL
+        slashed — entries within ``ct_gc_emergency_ttl_slash_s`` of expiry
+        are reclaimed early."""
         if now is None:
             now = int(time.time())
-        reclaimed = self.datapath.sweep(now)
+        eff_now = now + (self.config.ct_gc_emergency_ttl_slash_s
+                         if self._ct_emergency else 0)
+        reclaimed = self.datapath.sweep(eff_now)
         self.metrics.set_gauge("ct_last_sweep_reclaimed", reclaimed)
         if reclaimed:
             self.metrics.inc_counter("ct_gc_reclaimed_total", reclaimed)
@@ -722,7 +757,9 @@ class Engine:
         # and the reason the overlapped sweep_step derives occupancy
         # on-device instead of ever calling this
         st = self.datapath.ct_stats(now)
-        self.metrics.set_gauge("ct_occupancy", st["live"])
+        occ = st["live"] / max(1, st["capacity"])
+        self.metrics.set_gauge("ct_occupancy", round(occ, 6))
+        self._ct_pressure_update(occ)
         return reclaimed
 
     def sweep_step(self, now: Optional[int] = None) -> Optional[Dict]:
@@ -730,24 +767,123 @@ class Engine:
         controller body on capable backends): enqueue a donated chunk sweep
         that interleaves with live classify steps, harvest the previous
         tick's reclaimed/occupancy scalars, and export them
-        (``ct_gc_reclaimed_total`` counter, ``ct_occupancy`` gauge). The
-        ``ct.gc`` fault point drills the controller's supervised backoff."""
+        (``ct_gc_reclaimed_total`` counter, ``ct_occupancy`` gauge — a
+        live/capacity FRACTION). The ``ct.gc`` fault point drills the
+        controller's supervised backoff.
+
+        EMERGENCY mode (hysteresis latch on occupancy, see
+        ``_ct_pressure_update``): the tick runs ``ct_gc_emergency_chunks``
+        chunk sweeps instead of one, each with the effective TTL slashed
+        by ``ct_gc_emergency_ttl_slash_s`` — full-rate reclamation that
+        eats a flood's short-lived entries while leaving established
+        flows' 21600s lifetimes untouched. Occupancy always measures on
+        the REAL clock (only the sweep threshold is slashed): a slashed
+        count would exclude live entries the sweep has not reached yet,
+        read artificially low, and flap the enter/exit latch under a
+        sustained flood."""
         FAULTS.fire("ct.gc")
         if now is None:
             now = int(time.time())
+        cfg = self.config
+        emergency = self._ct_emergency
+        chunks = cfg.ct_gc_emergency_chunks if emergency else 1
+        slash = cfg.ct_gc_emergency_ttl_slash_s if emergency else 0
         # GC ticks are rare: always trace one (the datapath.ct.gc span
         # needs a context to attach to)
+        reclaimed = 0
         with TRACER.context(TRACER.force_sample()):
-            st = self.datapath.sweep_step(now,
-                                          self.config.ct_gc_chunk_rows)
-        if st["reclaimed"]:
-            self.metrics.inc_counter("ct_gc_reclaimed_total",
-                                     st["reclaimed"])
+            for _ in range(chunks):
+                # the slash rides only the SWEEP clock; occupancy keeps
+                # measuring at the real `now` (a slashed count would read
+                # low and flap the pressure latch)
+                st = self.datapath.sweep_step(now, cfg.ct_gc_chunk_rows,
+                                              ttl_slash_s=slash)
+                reclaimed += st["reclaimed"]
+        if emergency:
+            self.metrics.inc_counter("ct_emergency_sweeps_total", chunks)
+        if reclaimed:
+            self.metrics.inc_counter("ct_gc_reclaimed_total", reclaimed)
         if st["live"] >= 0:
-            self.metrics.set_gauge("ct_occupancy", st["live"])
+            occ = st["live"] / max(1, cfg.ct_capacity)
+            self.metrics.set_gauge("ct_occupancy", round(occ, 6))
+            self._ct_pressure_update(occ)
         self.metrics.set_gauge("ct_gc_epoch", st["epoch"])
         self.metrics.set_gauge("ct_gc_cursor", st["cursor"])
+        st = dict(st)
+        st["reclaimed"] = reclaimed
+        st["emergency"] = emergency
         return st
+
+    def overload_step(self) -> Optional[Dict]:
+        """One tick of the overload-ladder controller (the ``overload``
+        controller body; directly callable from the cfg6 bench/tests for
+        deterministic logical-time driving). Folds queue occupancy,
+        shed+admission-drop rate, and CT occupancy into the
+        pipeline/guard.OverloadLadder state machine and propagates the
+        state to the shedding sites: the admission queue (priority
+        shedding at PRESSURE, fail-fast at OVERLOAD) and the shim feeder
+        (harvest-time SHED-NEW). Transitions are gauged, counted, and
+        recorded as flight-recorder events (never frozen — the ladder IS
+        the system surviving). The ``overload.decide`` fault point drills
+        the controller's supervised backoff: a failing decider leaves the
+        last propagated state standing."""
+        FAULTS.fire("overload.decide")
+        cfg = self.config
+        if self._overload is None:
+            from cilium_tpu.pipeline.guard import OverloadLadder
+            self._overload = OverloadLadder(
+                queue_high=cfg.overload_queue_high,
+                queue_low=cfg.overload_queue_low,
+                shed_high=cfg.overload_shed_rate_high,
+                shed_low=cfg.overload_shed_rate_low,
+                ct_high=cfg.ct_pressure_high,
+                ct_low=cfg.ct_pressure_low,
+                up_ticks=cfg.overload_up_ticks,
+                down_ticks=cfg.overload_down_ticks)
+        pl = self._pipeline
+        ps = pl.stats() if pl is not None else None
+        fd = self._feeder
+        # the feeder's harvest-time prio sheds ride the shed signal too:
+        # under SHED-NEW the queue pressure vanishes BY DESIGN (that is the
+        # relief), and without this term the ladder would descend mid-storm
+        # and oscillate — sustained harvest shedding keeps the rung held
+        # until the flood actually stops
+        fd_shed = fd.prio_shed_rows if fd is not None else 0
+        if ps is not None:
+            queue_frac = ps["queue_depth"] / max(1, ps["queue_max"])
+            shed_now = ps["shed_total"] + ps["admission_drops"] + fd_shed
+        else:
+            queue_frac = 0.0
+            shed_now = self._overload_shed_prev
+        t = time.monotonic()
+        dt = (t - self._overload_shed_t) if self._overload_shed_t \
+            else cfg.overload_interval_s
+        rate = max(0, shed_now - self._overload_shed_prev) / max(dt, 1e-3)
+        self._overload_shed_prev = shed_now
+        self._overload_shed_t = t
+        ct_occ = float(self.metrics.gauges.get("ct_occupancy", 0.0))
+        state, changed = self._overload.observe(queue_frac, rate, ct_occ)
+        if pl is not None:
+            pl.set_overload_state(state)
+        fd = self._feeder
+        if fd is not None:
+            fd.set_overload_state(state)
+        self.metrics.set_gauge("overload_state", state)
+        if changed:
+            from cilium_tpu.pipeline.guard import OVERLOAD_STATE_NAMES
+            name = OVERLOAD_STATE_NAMES[state]
+            self.metrics.inc_counter(
+                f'overload_transitions_total{{to="{name}"}}')
+            self.blackbox.record_event(
+                "overload", state=name,
+                queue_frac=round(queue_frac, 4),
+                shed_rate=round(rate, 2),
+                ct_occupancy=round(ct_occ, 4))
+        return self._overload.status()
+
+    def overload_status(self) -> Optional[Dict]:
+        ov = self._overload
+        return ov.status() if ov is not None else None
 
     def start_background(self) -> None:
         """Start the periodic controllers and (when configured) the REST API
@@ -786,6 +922,15 @@ class Engine:
             self.controllers.update(
                 "obs-flush", self.flush_observability,
                 interval=self.config.obs_flush_interval_s)
+        if self.config.overload_enabled:
+            # the degradation ladder (pipeline/guard.OverloadLadder):
+            # queue/shed/CT pressure → OK/PRESSURE/OVERLOAD/SHED-NEW,
+            # propagated to the admission queue and the feeder — a
+            # supervised controller like every other (a crashing decider
+            # backs off; the last propagated state stands)
+            self.controllers.update(
+                "overload", self.overload_step,
+                interval=self.config.overload_interval_s)
         if self.config.autotune_enabled:
             # the closed loop (observe/autotune.py): queue-wait + fill
             # histograms → bounded flush_ms / bucket-floor adjustments
@@ -873,6 +1018,23 @@ class Engine:
                 "last_mismatch_revision": aud.last_mismatch_revision,
             }
             if doc["state"] == C.HEALTH_OK:
+                doc["state"] = C.HEALTH_DEGRADED
+        ov = self._overload
+        if ov is not None:
+            ost = ov.status()
+            # the ladder is COMMANDED degradation: PRESSURE still reports
+            # OK (the system is coping by reordering sheds), but OVERLOAD
+            # and SHED-NEW mean traffic is being refused wholesale — an
+            # operator-attention state
+            doc["overload"] = {
+                "state": ost["state"],
+                "level": ost["level"],
+                "since_s": ost["since_s"],
+                "inputs": ost["inputs"],
+            }
+            from cilium_tpu.pipeline.guard import OVERLOAD_OVERLOAD
+            if ost["level"] >= OVERLOAD_OVERLOAD \
+                    and doc["state"] == C.HEALTH_OK:
                 doc["state"] = C.HEALTH_DEGRADED
         if pl is not None:
             # outside the engine lock: pipeline stats take the pipeline

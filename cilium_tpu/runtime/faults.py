@@ -93,6 +93,16 @@ POINTS: Dict[str, str] = {
              "(Engine.sweep_step): trips exercise the ct-gc controller's "
              "supervised backoff — classify traffic and CT correctness "
              "must be untouched by a wedged/failing sweep",
+    "ct.insert": "the CT insert phase of one classify dispatch "
+                 "(JITDatapath.classify_async / FakeDatapath.classify): a "
+                 "trip rejects the batch — tickets fail closed in FIFO "
+                 "order, the breaker is fed — drilling verdict-FIFO "
+                 "survival while a DDoS flood saturates the table",
+    "overload.decide": "one tick of the overload-ladder controller "
+                       "(Engine.overload_step): trips drill the supervised "
+                       "backoff — the ladder state must HOLD (no flap to "
+                       "OK, no spurious escalation) while the decider "
+                       "itself is failing",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

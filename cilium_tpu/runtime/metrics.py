@@ -142,6 +142,7 @@ class Metrics:
         # silently truncate (add_batch validates the incoming shape too)
         self.by_reason_dir = np.zeros((C.COUNTER_CELLS,), dtype=np.uint64)
         self.insert_fail = 0
+        self.ct_evicted = 0
         self.packets_total = 0
         self.batches_total = 0
         self.spans: Dict[str, SpanStat] = {}
@@ -175,6 +176,9 @@ class Metrics:
         with self._lock:
             self.by_reason_dir += arr.astype(np.uint64)
             self.insert_fail += int(counters["insert_fail"])
+            # optional: legacy counter dicts (older backends, tests)
+            # predate the insert-when-full eviction accounting
+            self.ct_evicted += int(counters.get("ct_evicted", 0))
             self.packets_total += n_valid
             self.batches_total += 1
 
@@ -210,6 +214,8 @@ class Metrics:
                             f'direction="{C.DIR_NAMES[d]}"}} {int(arr[reason, d])}')
             lines.append("# TYPE ciliumtpu_ct_insert_fail_total counter")
             lines.append(f"ciliumtpu_ct_insert_fail_total {self.insert_fail}")
+            lines.append("# TYPE ciliumtpu_ct_evicted_total counter")
+            lines.append(f"ciliumtpu_ct_evicted_total {self.ct_evicted}")
             lines.append("# TYPE ciliumtpu_packets_total counter")
             lines.append(f"ciliumtpu_packets_total {self.packets_total}")
             lines.append("# TYPE ciliumtpu_batches_total counter")

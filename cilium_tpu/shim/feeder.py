@@ -44,12 +44,46 @@ from cilium_tpu.observe.trace import TRACER, Tracer
 from cilium_tpu.runtime.faults import FaultInjected
 from cilium_tpu.runtime.metrics import Metrics
 from cilium_tpu.shim.bindings import MAX_UNVERDICTED_BATCHES, FlowShim
+from cilium_tpu.utils import constants as C
 
 log = logging.getLogger("cilium_tpu.feeder")
 
 #: dense-LUT cap: one sparse/huge ep_id must not turn the per-snapshot
 #: LUT rebuild into a multi-GB allocation — fall back to dict lookups
 DENSE_LUT_MAX = 1 << 20
+
+#: established-flow filter geometry (pow2 slots): a direct-mapped
+#: fingerprint table of recently-established flow hashes — the harvest-time
+#: priority heuristic, NOT semantics (a collision merely promotes a flood
+#: flow's priority class; verdicts are untouched)
+EST_FILTER_SLOTS = 1 << 16
+
+
+def flow_hashes(b: Dict[str, np.ndarray]) -> np.ndarray:
+    """Direction-normalized flow hash per row (fwd XOR rev key hash — both
+    directions of a flow agree). The UNtranslated tuple, deliberately:
+    priority classing is a heuristic and must only be self-consistent
+    between its update (verdict apply) and lookup (harvest) sides; the
+    steering path keeps its own LB-translated hash."""
+    from cilium_tpu.kernels.hashing import hash_words_np
+    from cilium_tpu.kernels.records import ct_key_words
+    return (hash_words_np(ct_key_words(b))
+            ^ hash_words_np(ct_key_words(b, reverse=True)))
+
+
+def shed_new_rows(b: Dict[str, np.ndarray]) -> int:
+    """The SHED-NEW harvest-time shed, shared by the feeder and the cfg6
+    bench's synthetic harvest: invalidate every valid row whose ``_prio``
+    class is worse than established — those frames get their drop verdict
+    at apply time without EVER being submitted (rx-ring backpressure
+    relief), while established-class rows ride on. Returns rows shed."""
+    from cilium_tpu.pipeline.guard import PRIO_ESTABLISHED
+    v = b["valid"]
+    m = v & (np.asarray(b["_prio"]) > PRIO_ESTABLISHED)
+    n = int(m.sum())
+    if n:
+        v[m] = False                    # in place: poll buffers are pooled
+    return n
 
 
 def build_slot_lut(slot_of: Dict[int, int],
@@ -101,6 +135,7 @@ class ShimFeeder:
                  slo_ms: float = 0.0,
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
+                 event_sink=None,
                  name: str = "feeder"):
         if not 1 <= pool_batches <= MAX_UNVERDICTED_BATCHES:
             raise ValueError(
@@ -118,6 +153,11 @@ class ShimFeeder:
         self._idle_sleep_s = idle_sleep_s
         self._n_shards = n_shards
         self._name = name
+        # guard-event sink (the flight recorder): SHED-NEW harvest drops
+        # are narrated as kind="shed" reason="shed-new" events — the
+        # relaxed spike class (observe/blackbox.RELAXED_SHED_REASONS).
+        # Fired outside any lock, exceptions swallowed.
+        self._event_sink = event_sink
         # end-to-end latency SLO: harvest stamp → verdict apply, the TRUE
         # ingest→verdict number (queue wait + staging + dispatch + device +
         # FIFO head-of-line wait). slo_ms > 0 arms the burn counters.
@@ -125,6 +165,18 @@ class ShimFeeder:
 
         self._free: deque = deque(shim.make_poll_buffer()
                                   for _ in range(pool_batches))
+        # overload-ladder level (set by the engine's overload controller);
+        # >= SHED_NEW arms the harvest-time priority shed
+        self._overload_level = 0
+        # priority classing: every poll buffer carries a ``_prio`` column
+        # (pipeline/guard.PRIO_*) the admission queue ranks batches by;
+        # the established-flow filter below feeds class 0
+        from cilium_tpu.pipeline.guard import PRIO_NEW
+        for buf in self._free:
+            buf["_prio"] = np.full((shim.batch_size,), PRIO_NEW,
+                                   dtype=np.int8)
+        self._est_filter = np.zeros((EST_FILTER_SLOTS,), dtype=np.uint32)
+        self._est_mask = np.uint32(EST_FILTER_SLOTS - 1)
         if n_shards > 1:
             # software RSS (SURVEY §2): harvest pre-bins each record by the
             # direction-normalized flow hash so the pipeline's flush-time
@@ -154,6 +206,8 @@ class ShimFeeder:
         self.harvest_faults = 0
         self.errors = 0                    # unexpected step failures
         self.slo_burns = 0                 # applied batches past the SLO
+        self.prio_shed_rows = 0            # SHED-NEW harvest-time drops
+        self.prio_shed_batches = 0         # batches never submitted at all
         self._submit_rejects = 0           # log-throttle counter
 
     # -- lifecycle -----------------------------------------------------------
@@ -183,6 +237,11 @@ class ShimFeeder:
                 return
             self._thread = None
 
+    def set_overload_state(self, level: int) -> None:
+        """Propagate the overload-ladder level (engine's overload
+        controller); >= SHED-NEW arms the harvest-time priority shed."""
+        self._overload_level = int(level)
+
     def stats(self) -> Dict:
         t = self._thread
         e2e = self.metrics.histograms.get("ingest_e2e_latency_seconds")
@@ -193,6 +252,9 @@ class ShimFeeder:
             "rejected_batches": self.rejected_batches,
             "harvest_faults": self.harvest_faults,
             "errors": self.errors,
+            "overload_level": self._overload_level,
+            "prio_shed_rows": self.prio_shed_rows,
+            "prio_shed_batches": self.prio_shed_batches,
             "alive": bool(t is not None and t.is_alive()),
             "pending": len(self._pending),
             "pool_free": len(self._free),
@@ -267,9 +329,23 @@ class ShimFeeder:
             self.harvested_records += n_valid
             self.metrics.inc_counter("feeder_harvest_records_total",
                                      n_valid)
+            from cilium_tpu.pipeline.guard import OVERLOAD_SHED_NEW
+            submit = True
+            if self._overload_level >= OVERLOAD_SHED_NEW:
+                # the ladder's terminal rung: only established-class rows
+                # are submitted; everything else gets its drop verdict at
+                # apply time without touching the pipeline — the rx ring's
+                # real backpressure relief. A batch shed whole rides the
+                # pending queue as the all-drop sentinel (FIFO-safe).
+                if self._shed_new(b) and not bool(b["valid"].any()):
+                    submit = False
+                    self.prio_shed_batches += 1
+                    self.metrics.inc_counter(
+                        "feeder_prio_shed_batches_total")
             # the harvest stamp rides the ticket (true ingest→verdict
             # latency; monotonic — same clock as now_us above)
-            ticket = self.engine.submit(b, ingest_mono=now_us / 1e6)
+            if submit:
+                ticket = self.engine.submit(b, ingest_mono=now_us / 1e6)
         except Exception as e:   # noqa: BLE001 — unavailable/closed/
             # regen-storm engine.active/... : the shim already holds this
             # batch's FrameRefs, so a verdict MUST be consumed for it —
@@ -327,6 +403,20 @@ class ShimFeeder:
         unknown = slots < 0
         b["ep_slot"][:] = np.where(unknown, 0, slots)
         b["valid"] &= ~unknown
+        if "_prio" in b:
+            # priority classing while the columns are hot: flows in the
+            # established filter outrank new flows outrank
+            # unknown-endpoint traffic (pipeline/guard.PRIO_*) — what the
+            # admission queue ranks batches by under PRESSURE and the
+            # SHED-NEW harvest shed keys on
+            from cilium_tpu.pipeline.guard import (PRIO_ESTABLISHED,
+                                                   PRIO_NEW, PRIO_UNKNOWN)
+            h = flow_hashes(b)
+            hit = self._est_filter[h & self._est_mask] \
+                == (h | np.uint32(1))
+            pr = np.where(hit, PRIO_ESTABLISHED, PRIO_NEW).astype(np.int8)
+            pr[unknown] = PRIO_UNKNOWN
+            b["_prio"][:] = pr
         if self._n_shards > 1:
             # pre-bin while the columns are already hot in cache: the same
             # direction-normalized hash (post-DNAT tuple) the datapath and
@@ -339,6 +429,52 @@ class ShimFeeder:
             b["_shard"][:] = shard_bin_encode(
                 flow_shard_of(b, self._n_shards, lb=lb), snap.revision)
         return int(b["valid"].sum())
+
+    def _shed_new(self, b: Dict[str, np.ndarray]) -> int:
+        """SHED-NEW shed of one harvested batch (see shed_new_rows), with
+        per-class attribution counters — the ``{class=...}`` label family
+        operators alert on."""
+        from cilium_tpu.pipeline.guard import PRIO_NEW, PRIO_UNKNOWN
+        pr = np.asarray(b["_prio"])
+        v = np.asarray(b["valid"])
+        n_new = int((v & (pr == PRIO_NEW)).sum())
+        n_unk = int((v & (pr >= PRIO_UNKNOWN)).sum())
+        shed = shed_new_rows(b)
+        if shed:
+            self.prio_shed_rows += shed
+            if n_new:
+                self.metrics.inc_counter(
+                    'feeder_prio_shed_rows_total{class="new"}', n_new)
+            if n_unk:
+                self.metrics.inc_counter(
+                    'feeder_prio_shed_rows_total{class="unknown"}', n_unk)
+            if self._event_sink is not None:
+                try:
+                    self._event_sink("shed", reason="shed-new", rows=shed)
+                except Exception:   # noqa: BLE001 — observability only
+                    log.exception("feeder event sink failed")
+        return shed
+
+    def _note_established(self, buf, out) -> None:
+        """Feed the established-flow filter from applied verdicts: flows
+        observed allowed-ESTABLISHED/REPLY stamp their fingerprint, so the
+        NEXT harvest ranks them class 0. Never raises (verdict-apply hot
+        path); collisions only promote a colliding flow's class."""
+        try:
+            st = np.asarray(out["status"])
+            m = (np.asarray(out["allow"])
+                 & ((st == int(C.CTStatus.ESTABLISHED))
+                    | (st == int(C.CTStatus.REPLY)))
+                 & np.asarray(buf["valid"]))
+            if not m.any():
+                return
+            cols = {k: np.asarray(buf[k])[m]
+                    for k in ("src", "dst", "sport", "dport", "proto",
+                              "direction")}
+            h = flow_hashes(cols)
+            self._est_filter[h & self._est_mask] = h | np.uint32(1)
+        except Exception:   # noqa: BLE001 — heuristic, never load-bearing
+            log.exception("established-filter update failed")
 
     # -- verdict application (FIFO) -------------------------------------------
     def _apply_ready(self, block: bool,
@@ -377,6 +513,7 @@ class ShimFeeder:
                 out = ticket.result(timeout=0)
                 allow = out["allow"]
                 rejected = False
+                self._note_established(buf, out)
             except Exception:   # noqa: BLE001 — drop/shed/unavailable
                 pass
         try:

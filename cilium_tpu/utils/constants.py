@@ -136,6 +136,9 @@ class DropReason(enum.IntEnum):
     CT_INVALID = 134          # malformed / untrackable (e.g. bad header record)
     INVALID_IDENTITY = 135    # ipcache produced no usable identity
     UNSUPPORTED_PROTO = 136
+    CT_FULL = 137             # new flow: CT probe window saturated with
+    #                           unevictable entries (adversarial-load fail
+    #                           closed; upstream analog: CT map insert failed)
     NO_SERVICE = 140          # dst matched a service frontend with no backends
 
 

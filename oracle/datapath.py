@@ -35,7 +35,7 @@ Batch semantics — THE CONTRACT FOR THE TPU KERNELS:
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from cilium_tpu.model.ipcache import lpm_lookup
 from cilium_tpu.policy.repository import EndpointPolicy
@@ -75,6 +75,11 @@ class Verdict:
     remote_identity: int
     redirect: bool = False          # went through L7-lite matching
     matched_key: Optional[object] = None  # MapStateKey for trace
+    # CT exhaustion: a NEW allowed flow whose bounded-table probe window
+    # stayed full of unevictable entries even after the tail-eviction
+    # round — denied with DropReason.CT_FULL (fail closed on tracking
+    # exhaustion; see ConntrackTable's bounded mode)
+    ct_full: bool = False
     # service LB rewrites (bpf/lib/lb.h analog): forward DNAT applied before
     # classification; reply un-DNAT from the CT entry's rev-NAT id
     svc: bool = False
@@ -100,6 +105,7 @@ class CTEntry:
     pkts_rev: int = 0
     rev_nat: int = 0    # stable rev-NAT id + 1 (see compile/lb.LBTables);
                         # 0 = no service DNAT
+    slot: int = -1      # bounded-table slot (hash placement); -1 = unbounded
 
 
 def _tcp_lifetime(flags: int) -> int:
@@ -130,12 +136,60 @@ def _entry_expiry(proto: int, flags: int, now: int) -> int:
     return now + C.CT_LIFETIME_NONTCP
 
 
+def _ct_expirable(proto: int, flags: int) -> bool:
+    """Which live entries a saturated insert may tail-evict — the host
+    mirror of kernels/conntrack.ct_evictable: everything except
+    established TCP (SEEN_NON_SYN set, not closing). Under a SYN/UDP flood
+    the attack entries are all in the evictable class, so they churn among
+    themselves while established flows survive table exhaustion."""
+    return not (proto == C.PROTO_TCP
+                and (flags & C.CT_FLAG_SEEN_NON_SYN)
+                and not (flags & (C.CT_FLAG_TX_CLOSING
+                                  | C.CT_FLAG_RX_CLOSING)))
+
+
+def _key_words(key: CTKey):
+    """CTKey → the device's 10-word uint32 CT key (compile/ct_layout)."""
+    import numpy as np
+    w = np.zeros((10,), dtype=np.uint32)
+    w[0:4] = np.frombuffer(key[0], dtype=">u4")
+    w[4:8] = np.frombuffer(key[1], dtype=">u4")
+    w[8] = ((key[2] << 16) | key[3]) & 0xFFFFFFFF
+    w[9] = ((key[4] << 8) | key[5]) & 0xFFFFFFFF
+    return w
+
+
 class ConntrackTable:
     """Host-exact CT table. The device table must agree on lookup results,
-    flags, and expiry for every key (counters too, in snapshot mode)."""
+    flags, and expiry for every key (counters too, in snapshot mode).
 
-    def __init__(self):
+    ``capacity`` (power of two) arms the **bounded mode**: entries occupy
+    hash slots computed with the device's exact hash
+    (kernels/hashing.hash_words_np over the ct_layout key words) and the
+    same ``probe_depth`` linear window, so insert success/failure — and the
+    insert-when-full tail eviction — agree with the device table slot for
+    slot. The default (capacity=None) stays the unbounded dict of the
+    original contract: creates never fail (tests that never saturate keep
+    their exact old semantics)."""
+
+    def __init__(self, capacity: Optional[int] = None, probe_depth: int = 8):
         self.entries: Dict[CTKey, CTEntry] = {}
+        if capacity is not None and capacity & (capacity - 1):
+            raise ValueError("CT capacity must be a power of two")
+        self.capacity = capacity
+        self.probe_depth = probe_depth
+        # slot → (key, entry) physical occupancy; a slot is free iff empty
+        # or its OWN entry object is expired (a re-created key may leave a
+        # stale expired claim behind, exactly like the device's stale key
+        # words in an expired slot)
+        self._slots: Optional[List[Optional[Tuple[CTKey, CTEntry]]]] = \
+            [None] * capacity if capacity is not None else None
+        self.insert_fail = 0            # creates that found no slot
+        self.evicted = 0                # live entries tail-evicted
+
+    @property
+    def bounded(self) -> bool:
+        return self._slots is not None
 
     @staticmethod
     def fwd_key(p: PacketRecord) -> CTKey:
@@ -147,6 +201,68 @@ class ConntrackTable:
         return (p.dst_addr, p.src_addr, p.dst_port, p.src_port, p.proto,
                 1 - p.direction)
 
+    # -- bounded-mode slot mechanics ------------------------------------------
+    def base_slot(self, key: CTKey) -> int:
+        return int(self.base_slots([key])[0])
+
+    def base_slots(self, keys: Sequence[CTKey]):
+        """Vectorized base-slot hash for a batch of keys (one numpy hash
+        call — classify_batch_snapshot claims whole batches through this)."""
+        import numpy as np
+        from cilium_tpu.kernels.hashing import hash_words_np
+        w = np.stack([_key_words(k) for k in keys])
+        return (hash_words_np(w) & np.uint32(self.capacity - 1)).astype(int)
+
+    def _slot_free(self, s: int, now: int) -> bool:
+        occ = self._slots[s]
+        return occ is None or occ[1].expiry <= now
+
+    def _displace(self, s: int) -> None:
+        """Forget slot ``s``'s occupant (claimed by a new entry). The dict
+        entry is removed only when it still IS this occupant — a re-created
+        key's live entry elsewhere must survive its stale claim dying."""
+        occ = self._slots[s]
+        if occ is not None and self.entries.get(occ[0]) is occ[1]:
+            del self.entries[occ[0]]
+        self._slots[s] = None
+
+    def install(self, key: CTKey, entry: CTEntry, slot: int) -> None:
+        """Place ``entry`` at ``slot`` (bounded mode; the slot must have
+        been claimed through create()/claim_parallel()). An expired
+        occupant is displaced — the device analog physically overwrites
+        its words."""
+        if self._slots[slot] is not None:
+            self._displace(slot)
+        entry.slot = slot
+        self._slots[slot] = (key, entry)
+        self.entries[key] = entry
+
+    def _find_slot(self, key: CTKey, now: int,
+                   protected: Optional[set] = None) -> Tuple[int, bool]:
+        """→ (slot, evicts_live) for one create against current state, or
+        (-1, False) when the window is exhausted. Mirrors the device probe
+        order exactly: free slots first (earliest probe offset), then the
+        tail-eviction victim — smallest expiry among live evictable
+        unprotected occupants, ties to the earliest offset."""
+        cap = self.capacity
+        base = self.base_slot(key)
+        for r in range(self.probe_depth):
+            s = (base + r) % cap
+            if self._slot_free(s, now):
+                return s, False
+        best_s, best_e = -1, None
+        for r in range(self.probe_depth):
+            s = (base + r) % cap
+            if protected is not None and s in protected:
+                continue
+            k2, e2 = self._slots[s]
+            if not _ct_expirable(k2[4], e2.flags):
+                continue
+            if best_e is None or e2.expiry < best_e:
+                best_s, best_e = s, e2.expiry
+        return best_s, best_s >= 0
+
+    # -- the dict-facing contract ---------------------------------------------
     def probe(self, p: PacketRecord, now: int) -> Tuple[int, Optional[CTKey]]:
         """(CTStatus, hit key) against current state; expired = miss."""
         k = self.fwd_key(p)
@@ -168,23 +284,135 @@ class ConntrackTable:
         else:
             e.pkts_fwd += 1
 
-    def create(self, p: PacketRecord, now: int, rev_nat: int = 0) -> CTKey:
+    def create(self, p: PacketRecord, now: int,
+               rev_nat: int = 0) -> Optional[CTKey]:
+        """Create the forward entry. Bounded mode may fail: returns None
+        when the probe window is exhausted even after the eviction round —
+        the caller classifies the packet DROP CT_FULL (fail closed)."""
         key = self.fwd_key(p)
         flags = _flag_delta(p.proto, p.tcp_flags, is_reply=False)
-        self.entries[key] = CTEntry(
+        entry = CTEntry(
             expiry=_entry_expiry(p.proto, flags, now),
             created=now,
             flags=flags,
             pkts_fwd=1,
             rev_nat=rev_nat,
         )
+        if self.bounded:
+            s, evicts = self._find_slot(key, now)
+            if s < 0:
+                self.insert_fail += 1
+                return None
+            if evicts:
+                self.evicted += 1
+            self.install(key, entry, s)
+        else:
+            self.entries[key] = entry
         return key
+
+    def claim_parallel(self, creations: List[Tuple[int, CTKey]], now: int,
+                       protected: set) -> Tuple[Dict[CTKey, int], set, int]:
+        """Snapshot-batch slot claiming: the EXACT parallel-round protocol
+        of kernels/conntrack.ct_insert_new, at packet granularity —
+        per-round free-slot attempts with lowest-packet-index conflict
+        resolution, duplicate-key adoption sweeps, then one tail-eviction
+        round (victim = min expiry among live evictable unclaimed
+        unprotected window slots, ties to the earliest probe offset,
+        contested victims to the lowest packet index).
+
+        ``creations`` is [(packet_index, fwd_key)] in ascending index order
+        for every allowed NEW packet; ``protected`` is the set of slots any
+        packet of this batch probe-hit. Returns (claims: key → slot with
+        victims displaced and physical claims installed as stale-free
+        placeholders, failed_indices, n_evicted). The caller installs the
+        aggregated entries at the claimed slots afterwards."""
+        cap = self.capacity
+        if not creations:
+            return {}, set(), 0
+        bases = self.base_slots([k for _i, k in creations])
+        pend = [(i, k, int(b)) for (i, k), b in zip(creations, bases)]
+        claimed: Dict[int, CTKey] = {}          # slot → key (this batch)
+        claims: Dict[CTKey, int] = {}
+        for r in range(self.probe_depth):
+            if r > 0:
+                # adoption: a lower-indexed duplicate of my key may have
+                # won my previous round's target
+                still = []
+                for i, k, b in pend:
+                    sprev = (b + r - 1) % cap
+                    if claimed.get(sprev) == k:
+                        continue                 # adopted
+                    still.append((i, k, b))
+                pend = still
+            round_claims: Dict[int, Tuple[int, CTKey]] = {}
+            for i, k, b in pend:
+                s = (b + r) % cap
+                if s in claimed:
+                    continue
+                if self._slot_free(s, now):
+                    if s not in round_claims or i < round_claims[s][0]:
+                        round_claims[s] = (i, k)
+            won = {i for s, (i, _k) in round_claims.items()}
+            for s, (_i, k) in round_claims.items():
+                claimed[s] = k
+                claims[k] = s
+            pend = [(i, k, b) for i, k, b in pend if i not in won]
+        # final adoption sweep
+        still = []
+        for i, k, b in pend:
+            if any(claimed.get((b + r) % cap) == k
+                   for r in range(self.probe_depth)):
+                continue
+            still.append((i, k, b))
+        pend = still
+        # tail-eviction round (batch-start occupancy throughout)
+        evict_claims: Dict[int, Tuple[int, CTKey]] = {}
+        for i, k, b in pend:
+            best_s, best_e = -1, None
+            for r in range(self.probe_depth):
+                s = (b + r) % cap
+                if s in claimed or s in protected:
+                    continue
+                occ = self._slots[s]
+                if occ is None or occ[1].expiry <= now:
+                    continue                     # free slots are all claimed
+                if not _ct_expirable(occ[0][4], occ[1].flags):
+                    continue
+                if best_e is None or occ[1].expiry < best_e:
+                    best_s, best_e = s, occ[1].expiry
+            if best_s >= 0 and (best_s not in evict_claims
+                                or i < evict_claims[best_s][0]):
+                evict_claims[best_s] = (i, k)
+        n_evicted = 0
+        won = set()
+        for s, (i, k) in evict_claims.items():
+            self._displace(s)
+            n_evicted += 1
+            self.evicted += 1
+            claimed[s] = k
+            claims[k] = s
+            won.add(i)
+        pend = [(i, k, b) for i, k, b in pend if i not in won]
+        # adoption of evict-winners' duplicates
+        still = []
+        for i, k, b in pend:
+            if any(claimed.get((b + r) % cap) == k
+                   for r in range(self.probe_depth)):
+                continue
+            still.append((i, k, b))
+        failed = {i for i, _k, _b in still}
+        self.insert_fail += len(failed)
+        return claims, failed, n_evicted
 
     def sweep(self, now: int) -> int:
         """GC expired entries (upstream: ctmap GC); returns count removed."""
         dead = [k for k, e in self.entries.items() if e.expiry <= now]
         for k in dead:
             del self.entries[k]
+        if self._slots is not None:
+            for s, occ in enumerate(self._slots):
+                if occ is not None and occ[1].expiry <= now:
+                    self._slots[s] = None
         return len(dead)
 
     def __len__(self) -> int:
@@ -340,7 +568,8 @@ class Oracle:
                        matched_key=key), True
 
     # -- audit replay (observe/audit.py shadow-oracle parity) ----------------
-    def replay(self, p: PacketRecord, status: int) -> Tuple[Verdict, bool]:
+    def replay(self, p: PacketRecord, status: int,
+               ct_full: bool = False) -> Tuple[Verdict, bool]:
         """Re-derive the verdict for one packet given an externally observed
         CT probe result — the shadow-audit replay entry point.
 
@@ -356,13 +585,26 @@ class Oracle:
         packet creates its forward entry). Reply un-DNAT fields are NOT
         reconstructed (they come from the live CT entry's rev_nat id, which
         is not part of the captured probe input); callers check them for
-        structural consistency instead of bit-equality."""
+        structural consistency instead of bit-equality.
+
+        ``ct_full`` is the captured CT-exhaustion signal — like ``status``,
+        a datapath-internal table fact as-of classification (the insert's
+        probe window stayed saturated with unevictable entries after the
+        eviction round). It only ever EXCUSES a create the replay itself
+        demands: the verdict flips to the CT_FULL deny exactly when the
+        policy chain would have allowed-and-created, so a datapath that
+        flags ct_full on any other row still mismatches."""
         tp, rev_nat, no_backend = self._translate(p)
         if no_backend:
             return Verdict(False, C.DropReason.NO_SERVICE, C.CTStatus.NEW,
                            self._remote_identity(p)), False
         remote_id = self._remote_identity(tp)
         verdict, create = self._verdict_for(tp, remote_id, status)
+        if ct_full and create and verdict.allow:
+            verdict = replace(verdict, allow=False,
+                              drop_reason=C.DropReason.CT_FULL,
+                              ct_full=True)
+            create = False
         if rev_nat:
             verdict = replace(verdict, svc=True, nat_dst=tp.dst_addr,
                               nat_dport=tp.dst_port)
@@ -390,7 +632,12 @@ class Oracle:
                 self.ct.update(hit_key, tp,
                                is_reply=(status == C.CTStatus.REPLY), now=now)
         elif create:
-            self.ct.create(tp, now, rev_nat=rev_nat)
+            if self.ct.create(tp, now, rev_nat=rev_nat) is None:
+                # bounded table exhausted even after the eviction round:
+                # the flow is untrackable → fail closed (device mirror)
+                verdict = replace(verdict, allow=False,
+                                  drop_reason=C.DropReason.CT_FULL,
+                                  ct_full=True)
         return replace(verdict, **extra) if extra else verdict
 
     def classify_batch_sequential(self, packets: List[PacketRecord],
@@ -427,6 +674,31 @@ class Oracle:
                 extra.update(self._rnat_fields(self.ct.entries.get(hit_key),
                                                tp))
             verdicts.append(replace(verdict, **extra) if extra else verdict)
+
+        # Phase 1.5 (bounded tables): parallel slot claiming for creates —
+        # the exact round protocol of kernels/conntrack.ct_insert_new, so a
+        # saturated table fails (and tail-evicts) the same flows the device
+        # does. Slots any packet of this batch probe-hit are protected from
+        # eviction; packets whose claim fails flip to the CT_FULL deny
+        # BEFORE aggregation (their create never happens).
+        claims: Dict[CTKey, int] = {}
+        if self.ct.bounded:
+            protected = set()
+            for status, hit_key in probes:
+                if hit_key is not None:
+                    e = self.ct.entries.get(hit_key)
+                    if e is not None and e.slot >= 0:
+                        protected.add(e.slot)
+            creations = [(i, ConntrackTable.fwd_key(tps[i]))
+                         for i, (v, (status, _hk)) in enumerate(
+                             zip(verdicts, probes))
+                         if status == C.CTStatus.NEW and v.allow]
+            claims, failed, _n_evicted = self.ct.claim_parallel(
+                creations, now, protected)
+            for i in failed:
+                verdicts[i] = replace(verdicts[i], allow=False,
+                                      drop_reason=C.DropReason.CT_FULL,
+                                      ct_full=True)
 
         # Phase 2: order-independent aggregate CT effects.
         #   For each touched key: flags |= OR of deltas; counters += sums;
@@ -467,7 +739,13 @@ class Oracle:
                     continue
                 entry = CTEntry(expiry=0, created=now,
                                 rev_nat=a["rev_nat"])
-                self.ct.entries[key] = entry
+                if self.ct.bounded:
+                    slot = claims.get(key)
+                    if slot is None:
+                        continue     # claim failed: verdict already CT_FULL
+                    self.ct.install(key, entry, slot)
+                else:
+                    self.ct.entries[key] = entry
             proto = key[4]
             entry.flags |= a["flag_delta"]
             entry.pkts_fwd += a["fwd"]

@@ -394,9 +394,15 @@ class TestFeederSoak:
         """`make ingest-smoke` soak: 10k frames through the mock rings
         with shim.rx_ring faults armed the whole run — every frame gets a
         verdict, forwarded frames leave in exact injection order, and the
-        feeder/pipeline account for every batch."""
+        feeder/pipeline account for every batch.
+
+        ct_capacity is sized ABOVE the 10k distinct flows: this soak pins
+        FIFO under rx faults, not table exhaustion — at a saturated table
+        the insert-when-full contract (tests/test_ctfull.py) would
+        legitimately deny the overflow flows with CT_FULL."""
         eng = fake_engine(pipeline_queue_batches=256,
-                          ingest_pool_batches=8)
+                          ingest_pool_batches=8,
+                          ct_capacity=1 << 15)
         shim = mk_shim(batch_size=64)
         feeder = eng.start_feeder(shim)
         FAULTS.arm("shim.rx_ring", mode="prob", prob=0.05, seed=31)

@@ -226,7 +226,7 @@ class TestCtProbePairFused:
              for k, v in _random_batch(rng, n_flows).items()}
         keys = ctk.ct_key_words_jnp(b)
         want = jnp.ones((n_flows,), dtype=bool)
-        new_keys, new_created, zero_mask, slot, _fail = ctk.ct_insert_new(
+        new_keys, new_created, zero_mask, slot, _fail, _ev = ctk.ct_insert_new(
             ct, keys, want, jnp.uint32(100))
         ct = ctk.ct_apply(ct, b, slot, jnp.zeros((n_flows,), bool),
                           slot >= 0, jnp.uint32(100), new_keys=new_keys,

@@ -124,7 +124,7 @@ class TestCTKernel:
                 for i in range(4)]).items()}
         keys = ctk.ct_key_words_jnp(b)
         want = jnp.asarray([True] * 4)
-        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+        nk, ncr, zm, slot, fail, _ev = ctk.ct_insert_new(
             ct, keys, want, jnp.uint32(100))
         assert (np.asarray(slot) >= 0).all() and not np.asarray(fail).any()
         ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(4, bool), want,
@@ -138,7 +138,7 @@ class TestCTKernel:
         b = {k: jnp.asarray(v) for k, v in _mk_batch(
             4, [("10.0.0.1", "10.0.0.2", 7, 80, 6, 0)] * 4).items()}
         keys = ctk.ct_key_words_jnp(b)
-        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+        nk, ncr, zm, slot, fail, _ev = ctk.ct_insert_new(
             ct, keys, jnp.asarray([True] * 4), jnp.uint32(100))
         s = np.asarray(slot)
         assert (s == s[0]).all() and (s >= 0).all()
@@ -151,7 +151,7 @@ class TestCTKernel:
         tuples = [("10.0.0.1", "10.0.0.2", 100 + i, 80, 6, 0) for i in range(12)]
         b = {k: jnp.asarray(v) for k, v in _mk_batch(12, tuples).items()}
         keys = ctk.ct_key_words_jnp(b)
-        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+        nk, ncr, zm, slot, fail, _ev = ctk.ct_insert_new(
             ct, keys, jnp.asarray([True] * 12), jnp.uint32(100))
         assert int(np.asarray(fail).sum()) >= 4  # 8 slots, 12 flows
         assert int(np.asarray(zm).sum()) == 8
@@ -163,7 +163,7 @@ class TestCTKernel:
         b = {k: jnp.asarray(v) for k, v in raw.items()}
         keys = ctk.ct_key_words_jnp(b)
         one = jnp.asarray([True])
-        nk, ncr, zm, slot, fail = ctk.ct_insert_new(
+        nk, ncr, zm, slot, fail, _ev = ctk.ct_insert_new(
             ct, keys, one, jnp.uint32(100))
         ct2 = ctk.ct_apply(ct, b, slot, jnp.zeros(1, bool), one,
                            jnp.uint32(100), new_keys=nk,

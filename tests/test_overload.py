@@ -1,0 +1,405 @@
+"""Overload degradation ladder (ISSUE 10): OK → PRESSURE → OVERLOAD →
+SHED-NEW, priority shedding, SHED-NEW harvest shedding, the blackbox
+shed-reason split, and the labeled-metrics scrape race.
+
+The contract: the ladder is an explicit, hysteresis-latched state machine
+fed by queue/shed/CT pressure; PRESSURE arms priority shedding at the
+admission queue (established-class batches displace flood batches, counted
+``pipeline_shed_total{reason="priority"}``, FIFO-safe for everything that
+survives); OVERLOAD additionally fails admission fast; SHED-NEW makes the
+feeder drop non-established rows at harvest without ever submitting them.
+Ladder transitions and CT-emergency events are flight-recorder events that
+never freeze, and deliberate-shed spikes are judged against a relaxed
+threshold so a commanded storm cannot blind the recorder.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.observe.blackbox import FlightRecorder
+from cilium_tpu.pipeline import Pipeline, PipelineDrop
+from cilium_tpu.pipeline.guard import (OVERLOAD_OVERLOAD, OVERLOAD_PRESSURE,
+                                       OVERLOAD_SHED_NEW, PRIO_ESTABLISHED,
+                                       PRIO_NEW, PRIO_UNKNOWN,
+                                       OverloadLadder)
+from cilium_tpu.runtime.config import DaemonConfig
+from cilium_tpu.runtime.datapath import FakeDatapath
+from cilium_tpu.runtime.engine import Engine
+from cilium_tpu.runtime.faults import FAULTS, FaultInjected
+from cilium_tpu.runtime.metrics import Metrics
+from cilium_tpu.shim.feeder import shed_new_rows
+from tests.test_pipeline import EchoDispatch, sub_batch
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# --------------------------------------------------------------------------- #
+# the ladder state machine
+# --------------------------------------------------------------------------- #
+class TestOverloadLadder:
+    def mk(self, **kw):
+        kw.setdefault("up_ticks", 2)
+        kw.setdefault("down_ticks", 3)
+        return OverloadLadder(queue_high=0.75, queue_low=0.25,
+                              shed_high=50.0, shed_low=5.0,
+                              ct_high=0.85, ct_low=0.6, **kw)
+
+    def test_single_signal_holds_pressure(self):
+        lad = self.mk()
+        for _ in range(10):
+            state, _ = lad.observe(0.9, 0.0, 0.0)
+        assert state == OVERLOAD_PRESSURE     # one lit signal caps at 1
+
+    def test_two_signals_escalate_to_shed_new(self):
+        lad = self.mk()
+        states = [lad.observe(0.9, 100.0, 0.0)[0] for _ in range(8)]
+        assert states[-1] == OVERLOAD_SHED_NEW
+        assert OVERLOAD_OVERLOAD in states    # ramped, rung by rung
+
+    def test_hysteresis_latch_keeps_signal_lit_between_thresholds(self):
+        lad = self.mk(up_ticks=1)
+        lad.observe(0.9, 0.0, 0.0)            # queue lights at 0.9
+        for _ in range(10):
+            state, _ = lad.observe(0.5, 0.0, 0.0)   # between low and high
+        assert state == OVERLOAD_PRESSURE     # still lit — no flap
+        for _ in range(10):
+            state, _ = lad.observe(0.1, 0.0, 0.0)   # below low: clears
+        assert state == 0
+
+    def test_descent_is_slow(self):
+        lad = self.mk(up_ticks=1, down_ticks=4)
+        for _ in range(6):
+            lad.observe(0.9, 100.0, 0.9)
+        assert lad.state == OVERLOAD_SHED_NEW
+        downs = [lad.observe(0.0, 0.0, 0.0)[0] for _ in range(12)]
+        assert downs[-1] == 0
+        assert downs[2] == OVERLOAD_SHED_NEW   # held through early calm
+        # one rung at a time on the way down
+        assert sorted(set(downs), reverse=True) == \
+            sorted(set(downs), reverse=True)
+
+    def test_dwell_and_trail_recorded(self):
+        lad = self.mk(up_ticks=1)
+        time.sleep(0.02)                      # dwell accrues in OK first
+        lad.observe(0.9, 100.0, 0.0)
+        time.sleep(0.02)
+        lad.observe(0.9, 100.0, 0.0)
+        st = lad.status()
+        assert st["level"] >= OVERLOAD_PRESSURE
+        assert st["dwell_s"]["ok"] > 0
+        assert st["transitions"] >= 1
+        assert st["trail"][0]["frm"] == "ok"
+        assert st["inputs"]["severity"] >= 2
+
+    def test_bad_thresholds_rejected(self):
+        with pytest.raises(ValueError):
+            OverloadLadder(queue_high=0.2, queue_low=0.5)
+        with pytest.raises(ValueError):
+            OverloadLadder(up_ticks=0)
+
+
+# --------------------------------------------------------------------------- #
+# priority shedding at the admission queue
+# --------------------------------------------------------------------------- #
+def prio_batch(n_rows, start, prio):
+    b = sub_batch(n_rows, start)
+    b["_prio"] = np.full((n_rows,), prio, dtype=np.int8)
+    return b
+
+
+class TestPriorityShed:
+    def mk_pipeline(self, d, **kw):
+        kw.setdefault("max_bucket", 16)
+        kw.setdefault("min_bucket", 1)
+        kw.setdefault("queue_batches", 3)
+        kw.setdefault("flush_ms", 2.0)
+        kw.setdefault("block_timeout_s", 0.05)
+        return Pipeline(d, **kw)
+
+    def test_established_batch_displaces_flood_batch(self):
+        d = EchoDispatch()
+        d.gate.clear()                        # stall the worker
+        pl = self.mk_pipeline(d)
+        pl.set_overload_state(OVERLOAD_PRESSURE)
+        try:
+            flood = [pl.submit(prio_batch(4, 100 + 10 * i, PRIO_NEW))
+                     for i in range(4)]      # fills worker + queue(3)
+            legit = pl.submit(prio_batch(4, 900, PRIO_ESTABLISHED))
+            assert not legit.dropped          # admitted by displacement
+            victims = [t for t in flood if t.done()]
+            assert len(victims) == 1
+            with pytest.raises(PipelineDrop):
+                victims[0].result(timeout=1)
+            assert pl.metrics.counters[
+                'pipeline_shed_total{reason="priority"}'] == 1
+            assert pl.stats()["shed_reasons"] == {"priority": 1}
+            # the NEWEST flood batch was the victim: FIFO history survives
+            assert victims[0] is flood[-1]
+            d.gate.set()
+            assert pl.drain(timeout=10)
+            # every survivor resolves with its own rows, in order
+            for t in flood[:-1] + [legit]:
+                t.result(timeout=5)
+            assert d.sports_seen == [100, 101, 102, 103, 110, 111, 112,
+                                     113, 120, 121, 122, 123, 900, 901,
+                                     902, 903]
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+    def test_same_class_keeps_fifo_admission(self):
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = self.mk_pipeline(d)
+        pl.set_overload_state(OVERLOAD_PRESSURE)
+        try:
+            for i in range(4):
+                pl.submit(prio_batch(4, 100 + 10 * i, PRIO_NEW))
+            t = pl.submit(prio_batch(4, 900, PRIO_NEW))   # same class
+            assert t.dropped                  # block timeout → plain drop
+            assert pl.metrics.counters.get(
+                'pipeline_shed_total{reason="priority"}', 0) == 0
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+    def test_overload_level_fails_fast_without_blocking(self):
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = self.mk_pipeline(d, block_timeout_s=5.0)
+        pl.set_overload_state(OVERLOAD_OVERLOAD)
+        try:
+            for i in range(4):
+                pl.submit(prio_batch(4, 100 + 10 * i, PRIO_NEW))
+            t0 = time.monotonic()
+            t = pl.submit(prio_batch(4, 900, PRIO_NEW))
+            assert t.dropped
+            assert time.monotonic() - t0 < 1.0   # no 5s blocking wait
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+    def test_level_zero_changes_nothing(self):
+        d = EchoDispatch()
+        d.gate.clear()
+        pl = self.mk_pipeline(d)
+        try:
+            for i in range(4):
+                pl.submit(prio_batch(4, 100 + 10 * i, PRIO_NEW))
+            t = pl.submit(prio_batch(4, 900, PRIO_ESTABLISHED))
+            assert t.dropped                  # no ladder: plain admission
+            assert pl.metrics.counters.get(
+                'pipeline_shed_total{reason="priority"}', 0) == 0
+        finally:
+            d.gate.set()
+            pl.close(timeout=5)
+
+
+# --------------------------------------------------------------------------- #
+# SHED-NEW harvest shedding + priority classing
+# --------------------------------------------------------------------------- #
+class TestShedNew:
+    def test_shed_new_rows_drops_exactly_the_low_prio(self):
+        b = sub_batch(8, 100)
+        b["_prio"] = np.asarray(
+            [PRIO_ESTABLISHED, PRIO_NEW, PRIO_UNKNOWN, PRIO_ESTABLISHED,
+             PRIO_NEW, PRIO_NEW, PRIO_ESTABLISHED, PRIO_UNKNOWN],
+            dtype=np.int8)
+        shed = shed_new_rows(b)
+        assert shed == 5
+        assert b["valid"].tolist() == [True, False, False, True, False,
+                                       False, True, False]
+
+    def test_shed_new_events_ride_the_relaxed_spike_class(self):
+        """The feeder narrates SHED-NEW harvest drops to the flight
+        recorder as reason="shed-new" events — judged against the RELAXED
+        spike threshold, so a commanded storm records without freezing."""
+        from types import SimpleNamespace
+        from cilium_tpu.shim.feeder import ShimFeeder
+        fr = FlightRecorder(shed_spike=4, shed_window_s=60.0,
+                            shed_spike_relaxed=1000)
+        m = Metrics()
+        ns = SimpleNamespace(metrics=m, prio_shed_rows=0,
+                             _event_sink=fr.record_event)
+        for _ in range(20):
+            b = sub_batch(8, 100)
+            b["_prio"] = np.full((8,), PRIO_NEW, dtype=np.int8)
+            assert ShimFeeder._shed_new(ns, b) == 8
+        assert ns.prio_shed_rows == 160
+        assert m.counters[
+            'feeder_prio_shed_rows_total{class="new"}'] == 160
+        st = fr.stats()
+        assert st["events_total"] == 20           # narrated, every batch
+        assert st["frozen"] is False              # relaxed: no freeze
+
+    def test_engine_ladder_propagates_to_pipeline_and_health(self):
+        """Drive the engine's overload controller to SHED-NEW (shed + CT
+        signals) and assert propagation: pipeline overload level, gauges,
+        transition counters, blackbox events (recorded, not frozen), and
+        health DEGRADED at >= OVERLOAD."""
+        cfg = DaemonConfig(ct_capacity=1024, auto_regen=False,
+                           overload_up_ticks=1, overload_down_ticks=2,
+                           overload_shed_rate_high=10.0,
+                           overload_shed_rate_low=1.0)
+        eng = Engine(cfg, datapath=FakeDatapath(cfg))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.regenerate()
+        try:
+            pl = eng.start_pipeline()
+            assert eng.overload_step()["level"] == 0
+            # light the shed + CT signals (two signals → escalation)
+            eng.metrics.set_gauge("ct_occupancy", 0.95)
+            for _ in range(5):
+                pl.shed_total += 500          # test-internal: shed storm
+                st = eng.overload_step()
+            assert st["level"] == OVERLOAD_SHED_NEW
+            assert pl.stats()["overload_level"] == OVERLOAD_SHED_NEW
+            assert eng.metrics.gauges["overload_state"] == \
+                OVERLOAD_SHED_NEW
+            assert eng.metrics.counters[
+                'overload_transitions_total{to="shed-new"}'] == 1
+            health = eng.health()
+            assert health["overload"]["state"] == "shed-new"
+            assert health["state"] == "DEGRADED"
+            kinds = [e["kind"] for e in eng.blackbox._events]
+            assert kinds.count("overload") >= 3   # one per rung
+            assert eng.blackbox.stats()["frozen"] is False
+            # calm: the ladder descends and health recovers
+            eng.metrics.set_gauge("ct_occupancy", 0.0)
+            for _ in range(12):
+                st = eng.overload_step()
+            assert st["level"] == 0
+            assert eng.health()["state"] == "OK"
+            # the status surface carries the ladder
+            from cilium_tpu.runtime.api import status_doc
+            assert status_doc(eng)["overload"]["state"] == "ok"
+        finally:
+            eng.stop()
+
+    def test_overload_decide_fault_leaves_state_standing(self):
+        cfg = DaemonConfig(ct_capacity=1024, auto_regen=False,
+                           overload_up_ticks=1,
+                           overload_shed_rate_high=10.0,
+                           overload_shed_rate_low=1.0)
+        eng = Engine(cfg, datapath=FakeDatapath(cfg))
+        eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+        eng.regenerate()
+        try:
+            pl = eng.start_pipeline()
+            eng.metrics.set_gauge("ct_occupancy", 0.95)
+            for _ in range(4):
+                pl.shed_total += 500
+                eng.overload_step()
+            level = eng.overload_status()["level"]
+            assert level >= OVERLOAD_OVERLOAD
+            FAULTS.arm("overload.decide", mode="fail", times=3)
+            for _ in range(3):
+                with pytest.raises(FaultInjected):
+                    eng.overload_step()       # the controller would back off
+            # the last propagated state stands — no flap to OK
+            assert pl.stats()["overload_level"] == level
+            assert eng.overload_status()["level"] == level
+        finally:
+            eng.stop()
+
+
+# --------------------------------------------------------------------------- #
+# blackbox shed-reason split
+# --------------------------------------------------------------------------- #
+class TestBlackboxShedSplit:
+    def test_relaxed_reasons_do_not_freeze_at_strict_threshold(self):
+        fr = FlightRecorder(shed_spike=4, shed_window_s=60.0,
+                            shed_spike_relaxed=1000)
+        for i in range(100):
+            fr.record_event("shed", reason="priority", seq=i)
+        for i in range(100):
+            fr.record_event("shed", reason="shed-new", seq=i)
+        assert fr.stats()["frozen"] is False
+        # strict reasons still freeze at the strict threshold
+        for i in range(4):
+            fr.record_event("shed", reason="flush", seq=i)
+        st = fr.stats()
+        assert st["frozen"] is True
+        assert st["frozen_reason"] == "shed-spike"
+
+    def test_relaxed_spike_still_freezes_eventually(self):
+        fr = FlightRecorder(shed_spike=1000, shed_window_s=60.0,
+                            shed_spike_relaxed=8)
+        for i in range(8):
+            fr.record_event("shed", reason="priority", seq=i)
+        assert fr.stats()["frozen"] is True
+
+    def test_ladder_events_record_without_freezing(self):
+        fr = FlightRecorder()
+        fr.record_event("overload", state="shed-new", queue_frac=1.0)
+        fr.record_event("ct-emergency", action="enter", occupancy=0.9)
+        assert fr.stats()["frozen"] is False
+        kinds = [e["kind"] for e in fr._events]
+        assert kinds == ["overload", "ct-emergency"]
+
+
+# --------------------------------------------------------------------------- #
+# labeled-metrics scrape race (extends the PR 7 concurrent-scrape test)
+# --------------------------------------------------------------------------- #
+class TestLabeledScrapeRace:
+    def test_priority_and_class_label_families_race_render(self):
+        """The new {reason="priority"} / {class=...} counter families and
+        a labeled histogram racing continuous render_metrics scrapes
+        during simulated ladder transitions: no exception, each rendered
+        document has exactly one TYPE line per base metric, and the final
+        counts land."""
+        m = Metrics()
+        stop = threading.Event()
+        errors = []
+        renders = []
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    renders.append(m.render_prometheus())
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=scraper) for _ in range(2)]
+        for t in threads:
+            t.start()
+        n = 400
+        for i in range(n):
+            m.inc_counter('pipeline_shed_total{reason="priority"}')
+            m.inc_counter('pipeline_shed_total{reason="ingest"}')
+            m.inc_counter('feeder_prio_shed_rows_total{class="new"}', 3)
+            m.inc_counter(
+                'feeder_prio_shed_rows_total{class="unknown"}', 1)
+            m.inc_counter(f'overload_transitions_total{{to='
+                          f'"{("pressure", "overload")[i % 2]}"}}')
+            m.set_gauge("overload_state", i % 4)
+            m.histogram(
+                'ingest_e2e_latency_seconds{shard="0"}').observe(1e-4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        final = m.render_prometheus()
+        for base in ("pipeline_shed_total", "feeder_prio_shed_rows_total",
+                     "overload_transitions_total"):
+            assert final.count(f"# TYPE ciliumtpu_{base} counter") == 1
+        assert f'pipeline_shed_total{{reason="priority"}} {n}' in final
+        assert f'feeder_prio_shed_rows_total{{class="new"}} {3 * n}' \
+            in final
+        assert final.count(
+            "# TYPE ciliumtpu_ingest_e2e_latency_seconds histogram") == 1
+        assert f'ingest_e2e_latency_seconds_count{{shard="0"}} {n}' \
+            in final
+        # every mid-race render parsed as one-TYPE-per-base too
+        for doc in renders[:: max(1, len(renders) // 16)]:
+            for base in ("pipeline_shed_total",
+                         "overload_transitions_total"):
+                assert doc.count(f"# TYPE ciliumtpu_{base} counter") <= 1

@@ -198,6 +198,49 @@ class TestSteeredStaging:
         finally:
             pl.close(timeout=5)
 
+    def test_skewed_flood_sheds_one_shard_others_keep_serving(self):
+        """Adversarial skew (ISSUE 10 satellite): a flood whose flow hash
+        lands predominantly in ONE shard segment sheds with
+        reason="steer_overflow" FIFO-safely, while interleaved balanced
+        traffic keeps serving through the other shards with verdict
+        parity (the echo contract) for every surviving row."""
+        d = ViewEchoDispatch()
+        pl = sharded_pipeline(d, n_shards=4, max_bucket=16,
+                              shard_headroom=1)
+        try:
+            seg = pl.stats()["shard_capacity"]
+            assert seg < 16
+            outcomes = []                     # (ticket, kind) in FIFO order
+            for i in range(6):
+                if i % 2 == 0:
+                    flood = sub_batch(16, start=1000 + 100 * i)
+                    flood["sport"][:] = 1000 + 100 * i   # all → one shard
+                    outcomes.append((pl.submit(flood), "flood"))
+                else:
+                    legit = sub_batch(4, start=2000 + 100 * i)
+                    outcomes.append((pl.submit(legit), "legit"))
+            assert pl.drain(timeout=10)
+            for t, kind in outcomes:
+                if kind == "flood":
+                    with pytest.raises(PipelineDrop):
+                        t.result(timeout=5)
+                else:
+                    out = t.result(timeout=5)
+                    # echo parity for survivors: each row's own sport back
+                    start = int(out["reason"][0])
+                    assert out["reason"].tolist() == \
+                        list(range(start, start + 4))
+            s = pl.stats()
+            assert s["shed_reasons"] == {"steer_overflow": 3}
+            assert pl.metrics.counters[
+                'pipeline_shed_total{reason="steer_overflow"}'] == 3
+            assert s["restarts"] == 0         # the worker never died
+            # the surviving (balanced) rows actually spread across shards
+            rows_total = s["shard_rows_total"]
+            assert sum(rows_total) == 12 and max(rows_total) < 12
+        finally:
+            pl.close(timeout=5)
+
     def test_prebinned_shard_column_skips_hash(self):
         """A producer that pre-binned (the feeder's harvest hash) rides
         the ``_shard`` column (shard+1); shard_fn is never called."""
