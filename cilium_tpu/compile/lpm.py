@@ -6,19 +6,25 @@ family (mirroring upstream's separate v4/v6 maps), stride 8 bits, so an IPv4
 lookup is 4 dependent gathers and IPv6 is 16 — cost independent of prefix
 count (SURVEY.md §5: "LPM over 100k prefixes as multi-level stride tables").
 
-Node layout: ``nodes[n, 256, 2] int32`` —
+Node layout: ``nodes[n, 256, 3] int32`` —
   ``nodes[x, b, 0]`` = child node index, or -1 (no child);
   ``nodes[x, b, 1]`` = identity *index* decided at this byte, or -1 (inherit
-  the best match seen so far along the path).
+  the best match seen so far along the path);
+  ``nodes[x, b, 2]`` = packed match provenance ``(prefix_slot << 8) | plen``
+  for the prefix that decided this value, or -1. Prefix slots enumerate the
+  snapshot's canonical prefixes in sorted order (``LPMTables.prefixes``), so
+  a verdict can name the exact ipcache entry that won the walk — the
+  match-provenance column the observer/flowlog surfaces (ISSUE 11).
 A sentinel "dead" node of all -1 lets the fixed-depth device loop run to full
 depth without data-dependent control flow: after a path ends, the gather
 chain idles in the dead node. Misses resolve to ``default_index``
-(reserved:world), matching the datapath's WORLD_ID fallback.
+(reserved:world) with provenance -1, matching the datapath's WORLD_ID
+fallback.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -28,33 +34,67 @@ from cilium_tpu.utils.ip import parse_prefix
 V4_LEVELS = 4     # bytes 12..15 of the v4-mapped address
 V6_LEVELS = 16
 
+#: lpm_prefix packing: low 8 bits = canonical prefix length (0..128), the
+#: rest = prefix slot. One shared constant so the kernels, the oracle and
+#: the observer un-pack identically.
+PFX_LEN_BITS = 8
+PFX_LEN_MASK = (1 << PFX_LEN_BITS) - 1
+
+
+def pack_pfx(slot: int, plen: int) -> int:
+    return (slot << PFX_LEN_BITS) | (plen & PFX_LEN_MASK)
+
+
+def unpack_pfx(packed: int) -> Tuple[int, int]:
+    """packed lpm_prefix → (slot, plen); (-1, -1) for the miss sentinel."""
+    if packed < 0:
+        return -1, -1
+    return packed >> PFX_LEN_BITS, packed & PFX_LEN_MASK
+
 
 @dataclass(frozen=True)
 class LPMTables:
     """Host-built trie tensors for one snapshot."""
-    v4_nodes: np.ndarray   # [n4, 256, 2] int32
-    v6_nodes: np.ndarray   # [n6, 256, 2] int32
+    v4_nodes: np.ndarray   # [n4, 256, 3] int32
+    v6_nodes: np.ndarray   # [n6, 256, 3] int32
     default_index: int     # identity index for LPM miss (world)
+    # slot → canonical prefix string (sorted enumeration of the compiled
+    # ipcache); the inverse map resolves oracle/observer lookups to the
+    # same slot ids the device trie carries in its provenance plane
+    prefixes: Tuple[str, ...] = ()
+    pfx_slot_of: Dict[str, int] = field(default_factory=dict)
 
     @property
     def nbytes(self) -> int:
         return self.v4_nodes.nbytes + self.v6_nodes.nbytes
+
+    def describe(self, packed: int) -> Dict:
+        """Un-pack one lpm_prefix provenance value for display."""
+        slot, plen = unpack_pfx(int(packed))
+        if slot < 0 or slot >= len(self.prefixes):
+            return {"slot": -1, "prefix": None, "plen": -1}
+        return {"slot": slot, "prefix": self.prefixes[slot], "plen": plen}
 
 
 class _TrieBuilder:
     def __init__(self):
         # node 0 is the root; each node is {byte: child_idx} + per-byte value
         self.children: List[Dict[int, int]] = [{}]
-        self.values: List[Dict[int, int]] = [{}]
+        # values[node][b] = (plen_bits, identity_index, packed_provenance)
+        self.values: List[Dict[int, Tuple[int, int, int]]] = [{}]
 
     def _new_node(self) -> int:
         self.children.append({})
         self.values.append({})
         return len(self.children) - 1
 
-    def insert(self, addr_bytes: bytes, plen_bits: int, value: int) -> None:
+    def insert(self, addr_bytes: bytes, plen_bits: int, value: int,
+               meta: int = -1) -> None:
         """Insert a prefix of ``plen_bits`` (multiple-of-8 boundary handled by
-        expansion: a /12 covers 2^(16-12)=16 byte-values at level 2)."""
+        expansion: a /12 covers 2^(16-12)=16 byte-values at level 2).
+        ``meta`` is the packed provenance stored alongside the value — the
+        winner of a cell carries both, so value and provenance can never
+        name different prefixes."""
         node = 0
         full_bytes, rem_bits = divmod(plen_bits, 8)
         for level in range(full_bytes):
@@ -62,7 +102,7 @@ class _TrieBuilder:
             if level == full_bytes - 1 and rem_bits == 0:
                 old = self.values[node].get(b)
                 if old is None or old[0] <= plen_bits:
-                    self.values[node][b] = (plen_bits, value)
+                    self.values[node][b] = (plen_bits, value, meta)
                 return
             child = self.children[node].get(b)
             if child is None:
@@ -75,16 +115,17 @@ class _TrieBuilder:
         for b in range(b0, b0 + span):
             old = self.values[node].get(b)
             if old is None or old[0] <= plen_bits:
-                self.values[node][b] = (plen_bits, value)
+                self.values[node][b] = (plen_bits, value, meta)
 
     def to_array(self) -> np.ndarray:
         n = len(self.children)
-        arr = np.full((n + 1, 256, 2), -1, dtype=np.int32)  # +1 dead node
+        arr = np.full((n + 1, 256, 3), -1, dtype=np.int32)  # +1 dead node
         for idx in range(n):
             for b, child in self.children[idx].items():
                 arr[idx, b, 0] = child
-            for b, (_plen, value) in self.values[idx].items():
+            for b, (_plen, value, meta) in self.values[idx].items():
                 arr[idx, b, 1] = value
+                arr[idx, b, 2] = meta
         return arr
 
     @property
@@ -99,35 +140,51 @@ def build_lpm(ipcache_entries: Dict[str, int],
 
     ``identity_index`` maps identity id → dense index (the LPM leaf payload);
     entries referencing unknown identities raise (the compiler must be handed
-    a consistent snapshot).
+    a consistent snapshot). Prefix slots are assigned in sorted canonical
+    order — deterministic for any snapshot content, independent of the
+    ipcache dict's insertion history.
     """
     b4, b6 = _TrieBuilder(), _TrieBuilder()
-    for prefix, ident in ipcache_entries.items():
+    prefixes = tuple(sorted(ipcache_entries))
+    pfx_slot_of = {p: s for s, p in enumerate(prefixes)}
+    for prefix in prefixes:
+        ident = ipcache_entries[prefix]
         addr16, plen, is_v6 = parse_prefix(prefix)
         idx = identity_index[ident]
+        meta = pack_pfx(pfx_slot_of[prefix], plen)
         if is_v6:
-            b6.insert(addr16, plen, idx)
+            b6.insert(addr16, plen, idx, meta)
         else:
             # v4: trie over the last 4 bytes; /96+p → p bits here
-            b4.insert(addr16[12:], plen - 96, idx)
+            b4.insert(addr16[12:], plen - 96, idx, meta)
     return LPMTables(v4_nodes=b4.to_array(), v6_nodes=b6.to_array(),
-                     default_index=default_index)
+                     default_index=default_index,
+                     prefixes=prefixes, pfx_slot_of=pfx_slot_of)
 
 
 def lpm_lookup_host(tables: LPMTables, addr16: bytes, is_v6: bool) -> int:
     """Host-side reference walk of the trie tensors (for tests; the jnp
     kernel in kernels/lpm.py must agree with this AND with
     model.ipcache.lpm_lookup)."""
+    return lpm_lookup_host_prov(tables, addr16, is_v6)[0]
+
+
+def lpm_lookup_host_prov(tables: LPMTables, addr16: bytes,
+                         is_v6: bool) -> Tuple[int, int]:
+    """Reference walk returning (identity index, packed lpm_prefix
+    provenance) — the host mirror of kernels/lpm.lpm_walk_prov_core."""
     nodes = tables.v6_nodes if is_v6 else tables.v4_nodes
     data = addr16 if is_v6 else addr16[12:]
     levels = V6_LEVELS if is_v6 else V4_LEVELS
     node = 0
     dead = nodes.shape[0] - 1
     best = tables.default_index
+    best_meta = -1
     for level in range(levels):
         b = data[level]
-        child, value = nodes[node, b]
+        child, value, meta = nodes[node, b]
         if value >= 0:
             best = int(value)
+            best_meta = int(meta)
         node = int(child) if child >= 0 else dead
-    return best
+    return best, best_meta

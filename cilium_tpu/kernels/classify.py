@@ -36,7 +36,7 @@ from cilium_tpu.compile.ct_layout import PROBE_DEPTH
 from cilium_tpu.kernels import conntrack as ctk
 from cilium_tpu.kernels.l7 import l7_match_batch
 from cilium_tpu.kernels.lb import lb_step
-from cilium_tpu.kernels.lpm import lpm_lookup_batch
+from cilium_tpu.kernels.lpm import lpm_lookup_prov_batch
 from cilium_tpu.kernels.policy import policy_lookup_batch
 from cilium_tpu.utils import constants as C
 
@@ -88,9 +88,13 @@ def classify_interior_core(tensors, ep_slot, direction, id_idx, proto,
     holds by construction.
 
     → (allow [N] bool, reason [N] int32, status [N] int32,
-    redirect [N] bool); the NO_SERVICE override for LB no-backend drops is
-    the caller's job (it precedes this stage's inputs either way)."""
-    decision, l7_cell, enforced = policy_lookup_batch(
+    redirect [N] bool, matched_rule [N] int32); the NO_SERVICE override for
+    LB no-backend drops is the caller's job (it precedes this stage's
+    inputs either way). ``matched_rule`` is the ladder's provenance column
+    (kernels/policy.py): the resolved cell coordinate where a ladder
+    actually ran (valid row, enforced direction), -1 otherwise — identical
+    across the jnp reference, the fused kernel and the oracle."""
+    decision, l7_cell, enforced, mrule = policy_lookup_batch(
         tensors, ep_slot, direction, id_idx, proto, dport,
         rule_axis=rule_axis)
     # L7-lite: the CURRENT policy cell's rules apply to every packet with
@@ -103,8 +107,11 @@ def classify_interior_core(tensors, ep_slot, direction, id_idx, proto,
     set_to_check = jnp.where(cell_redirect, l7_cell, 0)
     l7_ok = l7_match_batch(tensors, set_to_check, http_method, http_path)
     l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
-    return compose_verdict(decision, enforced, cell_redirect, l7_fail,
-                           est, reply, valid)
+    allow, reason, status, redirect = compose_verdict(
+        decision, enforced, cell_redirect, l7_fail, est, reply, valid)
+    matched_rule = jnp.where(valid & enforced, mrule,
+                             jnp.int32(-1)).astype(jnp.int32)
+    return allow, reason, status, redirect, matched_rule
 
 
 def classify_step(tensors, ct, batch, now, world_index=0, *,
@@ -119,8 +126,9 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     out: allow [N] bool, reason [N] int32 (DropReason), status [N] int32
     (CTStatus), ct_full [N] bool (new flow denied because its CT probe
     window stayed exhausted after the eviction round), remote_identity [N]
-    uint32, redirect [N] bool, plus the NAT rewrite columns the shim
-    applies: svc [N] bool, nat_dst [N,4] uint32, nat_dport [N] int32
+    uint32, redirect [N] bool, the provenance columns matched_rule /
+    lpm_prefix / ct_state_pre [N] int32 (see the out dict below), plus the
+    NAT rewrite columns the shim applies: svc [N] bool, nat_dst [N,4] uint32, nat_dport [N] int32
     (forward DNAT) and rnat [N] bool, rnat_src [N,4] uint32,
     rnat_sport [N] int32 (reply un-DNAT).
     counters: by_reason_dir [COUNTER_CELLS] uint32 (reasons x directions),
@@ -162,20 +170,27 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
         svc = jnp.zeros((n,), dtype=bool)
         no_backend = jnp.zeros((n,), dtype=bool)
 
-    # 1. ipcache LPM: remote = dst on egress, src on ingress
+    # 1. ipcache LPM: remote = dst on egress, src on ingress. The walk
+    # resolves the identity index AND the winning prefix provenance
+    # ((slot << 8) | plen, -1 on miss) in the same register chain — the
+    # lpm_prefix out column below is the evidence for "why this identity"
     remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
                              batch["dst"], batch["src"])
     if plan is not None and plan.lpm:
-        id_idx = fk.lpm_lookup_fused(
+        id_idx, pfx_meta = fk.lpm_lookup_fused(
             tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
             batch["is_v6"], world_index, v4_only=v4_only,
             interpret=fused_interpret)
     else:
-        id_idx = lpm_lookup_batch(tensors["lpm_v4"], tensors["lpm_v6"],
-                                  remote_words, batch["is_v6"],
-                                  default_index=world_index,
-                                  v4_only=v4_only)
+        id_idx, pfx_meta = lpm_lookup_prov_batch(
+            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
+            batch["is_v6"], default_index=world_index, v4_only=v4_only)
     remote_identity = tensors["identity_ids"][id_idx].astype(jnp.uint32)
+    # provenance masking follows the same truth the columns they explain
+    # use: lpm_prefix for every row that was valid at ingest (NO_SERVICE
+    # rows keep their VIP-resolved identity AND its prefix), -1 otherwise
+    lpm_prefix = jnp.where(batch["valid"], pfx_meta,
+                           jnp.int32(-1)).astype(jnp.int32)
 
     # 2. conntrack probe (batch-start snapshot); the reverse key is a word
     # permutation of the forward key — normalized once, derived twice
@@ -196,15 +211,17 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     # 3-5. policy ladder + L7 token match + verdict composition (the fused
     # interior; see classify_interior_core)
     if plan is not None and plan.policy:
-        allow, reason, status, redirect = fk.policy_verdict_fused(
-            tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
-            batch["dport"], batch["http_method"], batch["http_path"],
-            est, reply, valid, interpret=fused_interpret)
+        allow, reason, status, redirect, matched_rule = \
+            fk.policy_verdict_fused(
+                tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
+                batch["dport"], batch["http_method"], batch["http_path"],
+                est, reply, valid, interpret=fused_interpret)
     else:
-        allow, reason, status, redirect = classify_interior_core(
-            tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
-            batch["dport"], batch["http_method"], batch["http_path"],
-            est, reply, valid, rule_axis=rule_axis)
+        allow, reason, status, redirect, matched_rule = \
+            classify_interior_core(
+                tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
+                batch["dport"], batch["http_method"], batch["http_path"],
+                est, reply, valid, rule_axis=rule_axis)
     reason = jnp.where(no_backend, int(C.DropReason.NO_SERVICE), reason)
 
     # 6. CT insert for allowed new flows — with the insert-when-full tail
@@ -274,6 +291,16 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
         "ct_full": ct_full,
         "remote_identity": remote_identity,
         "redirect": redirect,
+        # match provenance (ISSUE 11): the evidence behind the verdict —
+        # which policy cell the ladder resolved (matched_rule), which
+        # ipcache prefix won the LPM walk (lpm_prefix, (slot<<8)|plen),
+        # and the CT probe class as-of classification (ct_state_pre; an
+        # explicit alias of ``status``, pinned as its own column so the
+        # provenance contract survives any future post-mutation semantics
+        # of status). Bit-identical across jnp / fused / oracle.
+        "matched_rule": matched_rule,
+        "lpm_prefix": lpm_prefix,
+        "ct_state_pre": status,
         "svc": svc & valid,
         "nat_dst": batch["dst"],
         "nat_dport": batch["dport"].astype(jnp.int32),

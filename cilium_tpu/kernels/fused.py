@@ -51,7 +51,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from cilium_tpu.kernels.classify import classify_interior_core
 from cilium_tpu.kernels.conntrack import ct_probe_core
-from cilium_tpu.kernels.lpm import lpm_walk_core
+from cilium_tpu.kernels.lpm import lpm_walk_prov_core
 
 #: per-stage kernel-resident table budget (bytes). ~VMEM-scale by default;
 #: raise on hardware with the headroom, lower to force the jnp reference.
@@ -134,38 +134,47 @@ def _smem_scalar():
 def lpm_lookup_fused(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
                      v4_only: bool = False, interpret: bool = False):
     """One grid kernel over row blocks: both families' stride walks with
-    ``node``/``best`` held in registers (see lpm.lpm_walk_core — the same
-    function the jnp reference runs). ``default_index`` may be a traced
-    scalar (it is the snapshot's world index); it rides in SMEM."""
+    ``node``/``best``/``best_meta`` held in registers (see
+    lpm.lpm_walk_prov_core — the same function the jnp reference runs) →
+    (identity index [N] int32, packed lpm_prefix provenance [N] int32).
+    ``default_index`` may be a traced scalar (it is the snapshot's world
+    index); it rides in SMEM."""
     n = addr_words.shape[0]
     blk, grid = _row_grid(n)
 
     if v4_only:
-        def kernel(default_ref, v4_ref, addr_ref, out_ref):
-            out_ref[...] = lpm_walk_core(
+        def kernel(default_ref, v4_ref, addr_ref, idx_ref, meta_ref):
+            idx, meta = lpm_walk_prov_core(
                 v4_ref[...], None, addr_ref[...], None, default_ref[0],
                 v4_only=True)
+            idx_ref[...] = idx
+            meta_ref[...] = meta
         in_specs = [_smem_scalar(), _full(lpm_v4.shape), _rows(blk, (4,))]
         args = (jnp.asarray(default_index, jnp.int32).reshape(1),
                 lpm_v4, addr_words)
     else:
-        def kernel(default_ref, v4_ref, v6_ref, addr_ref, isv6_ref, out_ref):
-            out_ref[...] = lpm_walk_core(
+        def kernel(default_ref, v4_ref, v6_ref, addr_ref, isv6_ref,
+                   idx_ref, meta_ref):
+            idx, meta = lpm_walk_prov_core(
                 v4_ref[...], v6_ref[...], addr_ref[...], isv6_ref[...],
                 default_ref[0], v4_only=False)
+            idx_ref[...] = idx
+            meta_ref[...] = meta
         in_specs = [_smem_scalar(), _full(lpm_v4.shape), _full(lpm_v6.shape),
                     _rows(blk, (4,)), _rows(blk)]
         args = (jnp.asarray(default_index, jnp.int32).reshape(1),
                 lpm_v4, lpm_v6, addr_words, is_v6.astype(jnp.int32))
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=in_specs,
-        out_specs=_rows(blk),
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        out_specs=[_rows(blk), _rows(blk)],
+        out_shape=[jax.ShapeDtypeStruct((n,), jnp.int32),
+                   jax.ShapeDtypeStruct((n,), jnp.int32)],
         interpret=interpret,
     )(*args)
+    return out[0], out[1]
 
 
 # --------------------------------------------------------------------------- #
@@ -213,7 +222,7 @@ def policy_verdict_fused(tensors, ep_slot, direction, id_idx, proto, dport,
     """Steps 3-5 of classify_step in one kernel (the body IS
     classify.classify_interior_core over VMEM-resident tables) →
     (allow [N] bool, reason [N] int32, status [N] int32,
-    redirect [N] bool)."""
+    redirect [N] bool, matched_rule [N] int32)."""
     n = valid.shape[0]
     blk, grid = _row_grid(n)
     # bool tables ride as uint8 (TPU-friendly); the core casts back — the
@@ -234,14 +243,14 @@ def policy_verdict_fused(tensors, ep_slot, direction, id_idx, proto, dport,
     def kernel(*refs):
         row_refs = refs[:10]
         tab_refs = refs[10:10 + len(tab_names)]
-        allow_ref, reason_ref, status_ref, redirect_ref = \
-            refs[10 + len(tab_names):]
+        (allow_ref, reason_ref, status_ref, redirect_ref,
+         mrule_ref) = refs[10 + len(tab_names):]
         t = {name: ref[...] for name, ref in zip(tab_names, tab_refs)}
         t["enforced"] = t["enforced"].astype(bool)
         t["l7_valid"] = t["l7_valid"].astype(bool)
         (ep_r, dir_r, id_r, proto_r, dport_r, meth_r, path_r, est_r,
          reply_r, valid_r) = row_refs
-        allow, reason, status, redirect = classify_interior_core(
+        allow, reason, status, redirect, mrule = classify_interior_core(
             t, ep_r[...], dir_r[...], id_r[...], proto_r[...], dport_r[...],
             meth_r[...], path_r[...], est_r[...].astype(bool),
             reply_r[...].astype(bool), valid_r[...].astype(bool))
@@ -249,6 +258,7 @@ def policy_verdict_fused(tensors, ep_slot, direction, id_idx, proto, dport,
         reason_ref[...] = reason
         status_ref[...] = status
         redirect_ref[...] = redirect.astype(jnp.int32)
+        mrule_ref[...] = mrule
 
     # row-arg order matches the kernel's unpacking above: ep, dir, id,
     # proto, dport, method, path, est, reply, valid
@@ -259,12 +269,12 @@ def policy_verdict_fused(tensors, ep_slot, direction, id_idx, proto, dport,
         + [_rows(blk)] * 3
     tab_specs = [_full(tabs[k].shape) for k in tab_names]
 
-    allow, reason, status, redirect = pl.pallas_call(
+    allow, reason, status, redirect, mrule = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=row_specs + tab_specs,
-        out_specs=[_rows(blk)] * 4,
-        out_shape=[jax.ShapeDtypeStruct((n,), jnp.int32)] * 4,
+        out_specs=[_rows(blk)] * 5,
+        out_shape=[jax.ShapeDtypeStruct((n,), jnp.int32)] * 5,
         interpret=interpret,
     )(*row_args, *(tabs[k] for k in tab_names))
-    return allow.astype(bool), reason, status, redirect.astype(bool)
+    return allow.astype(bool), reason, status, redirect.astype(bool), mrule

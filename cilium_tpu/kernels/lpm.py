@@ -5,13 +5,20 @@ the sentinel node. A mixed-family batch walks both tries and selects by the
 family bit (mirroring upstream's two LPM maps); ``v4_only=True`` (static)
 skips the 16-level v6 walk for pure-IPv4 workloads (BASELINE config 1).
 
-``lpm_walk_core`` is the *fusable core*: pure jnp over plain arrays, so the
-exact same function executes (a) as the XLA reference here and (b) inside
+``lpm_walk_prov_core`` is the *fusable core*: pure jnp over plain arrays, so
+the exact same function executes (a) as the XLA reference here and (b) inside
 the Pallas megakernel body (kernels/fused.py) over values read from refs —
 bit-identity between the two paths holds by construction, not by test luck.
+It returns BOTH the identity index and the packed match provenance
+``(prefix_slot << 8) | plen`` carried in the trie's third plane
+(compile/lpm.py): the walk that resolves the identity IS the walk that names
+the winning prefix, so the two can never disagree. ``lpm_walk_core`` /
+``lpm_lookup_batch`` keep the index-only contract for callers that do not
+need provenance.
+
 The per-level gather is flattened to a single-axis ``take`` (node*256+byte)
 so the Mosaic lowering sees one supported gather per level instead of a 3-D
-fancy index; in-range indices make it bit-identical to the 2-D form (node is
+fancy index; in-range indices make it bit-identical to the 3-D form (node is
 always a real node or the dead sentinel, byte is masked to 0..255).
 """
 
@@ -23,45 +30,68 @@ from cilium_tpu.compile.lpm import V4_LEVELS, V6_LEVELS
 
 
 def _walk(nodes, addr_words, byte_index, levels, default_index):
-    """nodes [n,256,2] int32; addr_words [N,4] uint32; byte_index(l) gives the
-    byte position 0..15 in the 16-byte address for level l. ``node`` and
-    ``best`` live in registers across the whole chain — nothing but the
-    node-pair gather touches memory per level."""
+    """nodes [n,256,3] int32; addr_words [N,4] uint32; byte_index(l) gives the
+    byte position 0..15 in the 16-byte address for level l. ``node``,
+    ``best`` and ``best_meta`` live in registers across the whole chain —
+    nothing but the node-triple gather touches memory per level. Returns
+    (best identity index [N], best packed provenance [N], -1 on miss)."""
     n_nodes = nodes.shape[0]
     dead = n_nodes - 1
     n = addr_words.shape[0]
-    flat = nodes.reshape(-1, 2)
+    flat = nodes.reshape(-1, 3)
     node = jnp.zeros((n,), dtype=jnp.int32)
     # default_index may be a traced scalar (snapshot-dependent) — broadcast,
     # don't bake
     best = jnp.broadcast_to(jnp.asarray(default_index, jnp.int32), (n,))
+    best_meta = jnp.full((n,), -1, dtype=jnp.int32)
     for level in range(levels):
         pos = byte_index(level)
         word = addr_words[:, pos // 4]
         b = ((word >> jnp.uint32(8 * (3 - pos % 4))) & jnp.uint32(0xFF)
              ).astype(jnp.int32)
-        pair = flat[node * 256 + b]               # [N, 2]
-        child, value = pair[:, 0], pair[:, 1]
-        best = jnp.where(value >= 0, value, best)
+        triple = flat[node * 256 + b]             # [N, 3]
+        child, value, meta = triple[:, 0], triple[:, 1], triple[:, 2]
+        hit = value >= 0
+        best = jnp.where(hit, value, best)
+        best_meta = jnp.where(hit, meta, best_meta)
         node = jnp.where(child >= 0, child, dead)
-    return best
+    return best, best_meta
+
+
+def lpm_walk_prov_core(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
+                       v4_only: bool = False):
+    """The fusable core: [N,4] v4-mapped address words → (identity index
+    [N] int32, packed lpm_prefix provenance [N] int32, -1 on miss).
+    ``is_v6`` may be bool or a 0/1 integer mask (the Pallas body ships it as
+    int32). ``v4_only`` (static) elides the 16-level v6 chain."""
+    r4, m4 = _walk(lpm_v4, addr_words, lambda l: 12 + l, V4_LEVELS,
+                   default_index)
+    if v4_only:
+        return r4, m4
+    r6, m6 = _walk(lpm_v6, addr_words, lambda l: l, V6_LEVELS, default_index)
+    v6 = is_v6.astype(bool)
+    return jnp.where(v6, r6, r4), jnp.where(v6, m6, m4)
 
 
 def lpm_walk_core(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
                   v4_only: bool = False):
-    """The fusable core: [N,4] v4-mapped address words → identity index
-    [N] int32. ``is_v6`` may be bool or a 0/1 integer mask (the Pallas body
-    ships it as int32). ``v4_only`` (static) elides the 16-level v6 chain."""
-    r4 = _walk(lpm_v4, addr_words, lambda l: 12 + l, V4_LEVELS, default_index)
-    if v4_only:
-        return r4
-    r6 = _walk(lpm_v6, addr_words, lambda l: l, V6_LEVELS, default_index)
-    return jnp.where(is_v6.astype(bool), r6, r4)
+    """Index-only view of :func:`lpm_walk_prov_core` (compat surface for
+    callers that predate match provenance)."""
+    return lpm_walk_prov_core(lpm_v4, lpm_v6, addr_words, is_v6,
+                              default_index, v4_only=v4_only)[0]
+
+
+def lpm_lookup_prov_batch(lpm_v4, lpm_v6, addr_words, is_v6,
+                          default_index: int, v4_only: bool = False):
+    """addr_words [N,4] uint32 (16-byte normalized, v4-mapped) →
+    (identity index [N] int32, packed lpm_prefix [N] int32)."""
+    return lpm_walk_prov_core(lpm_v4, lpm_v6, addr_words, is_v6,
+                              default_index, v4_only=v4_only)
 
 
 def lpm_lookup_batch(lpm_v4, lpm_v6, addr_words, is_v6, default_index: int,
                      v4_only: bool = False):
     """addr_words [N,4] uint32 (16-byte normalized, v4-mapped) → identity
     index [N] int32."""
-    return lpm_walk_core(lpm_v4, lpm_v6, addr_words, is_v6, default_index,
-                         v4_only=v4_only)
+    return lpm_walk_prov_core(lpm_v4, lpm_v6, addr_words, is_v6,
+                              default_index, v4_only=v4_only)[0]

@@ -7,7 +7,18 @@ dict, shared verbatim by the XLA reference and the fused Pallas verdict
 kernel (kernels/fused.py). Every gather is explicitly clipped then
 flattened to a single-axis take — the clip reproduces jax's out-of-bounds
 clamp semantics exactly (so garbage rows cannot diverge between the two
-executors) and the flat form is the one gather shape Mosaic lowers."""
+executors) and the flat form is the one gather shape Mosaic lowers.
+
+Besides the cell, the lookup emits ``matched_rule``: the (id_class,
+port_class) coordinate of the resolved verdict cell, packed
+``id_cls * n_port_classes + port_cls`` — layout-independent (never an index
+into the possibly rule-shard-padded image), identical across the jnp
+reference, the fused kernel, the rule-sharded mesh and the host oracle by
+construction. Callers mask it to -1 where no ladder ran (invalid row or
+unenforced direction); together with the endpoint slot and direction it
+names the exact policy-map row that decided the verdict (the flowlog /
+observer provenance of ISSUE 11).
+"""
 
 from __future__ import annotations
 
@@ -17,8 +28,9 @@ from cilium_tpu.utils import constants as C
 
 
 def policy_core(tensors, ep_slot, direction, id_index, proto, dport):
-    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool) against
-    the dense (un-sharded) verdict image."""
+    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool,
+    matched_rule [N] int32 — unmasked cell coordinate) against the dense
+    (un-sharded) verdict image."""
     n_ids = tensors["id_class_of"].shape[0]
     id_cls = tensors["id_class_of"][jnp.clip(id_index, 0, n_ids - 1)]
     fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
@@ -36,18 +48,23 @@ def policy_core(tensors, ep_slot, direction, id_index, proto, dport):
     enforced = tensors["enforced"].reshape(-1)[ep * 2 + d].astype(bool)
     decision = cell & C.VERDICT_DECISION_MASK
     l7_id = cell >> C.VERDICT_L7_SHIFT
-    return decision, l7_id, enforced
+    matched_rule = (id_cls * n_cols + pcls).astype(jnp.int32)
+    return decision, l7_id, enforced, matched_rule
 
 
 def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
                         rule_axis=None):
-    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool).
+    """→ (decision [N] int32, l7_id [N] int32, enforced [N] bool,
+    matched_rule [N] int32).
 
     ``rule_axis``: name of a mesh axis over which the verdict tensor's
     id-class rows are sharded (the "tensor parallelism over rule space" of
     SURVEY.md §2's parallelism table). Each shard gathers rows it owns and a
     psum combines — one XLA collective, no gather of remote rows. Rows must
     be padded to a multiple of the axis size (compile/parallel handles it).
+    ``matched_rule`` uses the GLOBAL id class (id_class_of is replicated),
+    so its value is identical on every shard and to the un-sharded path —
+    no collective needed.
     """
     if rule_axis is None:
         return policy_core(tensors, ep_slot, direction, id_index, proto,
@@ -57,6 +74,7 @@ def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
     fam = tensors["proto_family"][jnp.clip(proto, 0, 255)]
     pcls = tensors["port_class"][fam, jnp.clip(dport, 0, 65535)]
     rows_local = tensors["verdict"].shape[2]
+    n_cols = tensors["verdict"].shape[3]
     ri = jax.lax.axis_index(rule_axis)
     local_idx = id_cls - ri * rows_local
     in_range = (local_idx >= 0) & (local_idx < rows_local)
@@ -69,4 +87,5 @@ def policy_lookup_batch(tensors, ep_slot, direction, id_index, proto, dport,
     enforced = tensors["enforced"][ep_slot, direction]
     decision = cell & C.VERDICT_DECISION_MASK
     l7_id = cell >> C.VERDICT_L7_SHIFT
-    return decision, l7_id, enforced
+    matched_rule = (id_cls * n_cols + pcls).astype(jnp.int32)
+    return decision, l7_id, enforced, matched_rule

@@ -83,10 +83,22 @@ def lpm_lookup(entries: Dict[str, int], addr: str) -> int:
     cover v4-mapped addresses. The device side mirrors this with two stride
     tries selected by the packet's family bit.
     """
+    return lpm_lookup_pfx(entries, addr)[0]
+
+
+def lpm_lookup_pfx(entries: Dict[str, int], addr: str
+                   ) -> Tuple[int, Optional[str], int]:
+    """LPM with match provenance: → (identity id, winning canonical prefix
+    or None on miss, canonical prefix length or -1). The winning prefix is
+    unique (two same-length prefixes covering one address are the same
+    prefix), so this names exactly the entry whose slot the device trie's
+    provenance plane carries (compile/lpm.py) — the oracle's half of the
+    ``lpm_prefix`` bit-identity contract."""
     addr16, addr_is_v6 = parse_addr(addr)
     addr_int = int.from_bytes(addr16, "big")
     best_len = -1
     best_id = C.IDENTITY_WORLD
+    best_pfx: Optional[str] = None
     for prefix, ident in entries.items():
         net16, plen, pfx_is_v6 = parse_prefix(prefix)
         if pfx_is_v6 != addr_is_v6:
@@ -96,4 +108,5 @@ def lpm_lookup(entries: Dict[str, int], addr: str) -> int:
             if plen > best_len:
                 best_len = plen
                 best_id = ident
-    return best_id
+                best_pfx = prefix
+    return best_id, best_pfx, (best_len if best_pfx is not None else -1)

@@ -71,9 +71,13 @@ log = logging.getLogger("cilium_tpu.audit")
 #: rnat fields ride along for the structural consistency check; ct_full is
 #: the CT-exhaustion signal — same truth class as status, a table fact
 #: as-of classification that replay takes as given and may only use to
-#: EXCUSE a create it would itself demand)
+#: EXCUSE a create it would itself demand). The provenance columns
+#: (matched_rule / lpm_prefix / ct_state_pre, ISSUE 11) are part of the
+#: audited surface: a verdict that is right for the wrong reason — correct
+#: allow bit, wrong winning rule or prefix — is a provenance mismatch.
 AUDIT_OUT_KEYS = ("allow", "reason", "status", "ct_full", "remote_identity",
-                  "redirect", "svc", "nat_dst", "nat_dport", "rnat")
+                  "redirect", "svc", "nat_dst", "nat_dport", "rnat",
+                  "matched_rule", "lpm_prefix", "ct_state_pre")
 
 #: batch columns a capture snapshots (the classify inputs; ``_``-prefixed
 #: staging extras are deliberately excluded — they are transport metadata,
@@ -351,6 +355,23 @@ class ShadowAuditor:
             got_delta = _ct_delta(got_allow, got_status, True)
             if want_delta != got_delta:
                 diffs["ct_delta"] = (want_delta, got_delta)
+            # provenance columns (ISSUE 11): the replayed oracle names the
+            # winning rule/prefix from the same compiled tables — right
+            # verdict for the wrong reason still mismatches
+            if "matched_rule" in out and \
+                    int(verdict.matched_rule) != int(out["matched_rule"][i]):
+                diffs["matched_rule"] = (int(verdict.matched_rule),
+                                         int(out["matched_rule"][i]))
+            if "lpm_prefix" in out and \
+                    int(verdict.lpm_prefix) != int(out["lpm_prefix"][i]):
+                diffs["lpm_prefix"] = (int(verdict.lpm_prefix),
+                                       int(out["lpm_prefix"][i]))
+            # ct_state_pre is an alias of the captured probe class by
+            # contract — structural, like rnat below
+            if "ct_state_pre" in out and \
+                    int(out["ct_state_pre"][i]) != got_status:
+                diffs["ct_state_pre"] = (got_status,
+                                         int(out["ct_state_pre"][i]))
             # structural rnat check: reply un-DNAT without a REPLY CT hit
             # is impossible by construction
             if "rnat" in out and bool(out["rnat"][i]) \

@@ -152,6 +152,22 @@ class FlightRecorder:
             summary = {"t": time.time(), "now": now, "rows": n_valid,
                        "allowed": int(allow.sum()),
                        "dropped": int(dropped.size), "top_reasons": top}
+            # match provenance (ISSUE 11): which policy cells / ipcache
+            # prefixes / CT classes the batch's drops concentrate on — a
+            # frozen bundle explains its anomalous flows without a replay
+            drop_m = ~allow & (reasons != 0)
+            for col, key in (("matched_rule", "top_drop_rules"),
+                             ("lpm_prefix", "top_drop_prefixes"),
+                             ("ct_state_pre", "drop_ct_states")):
+                if col not in out:
+                    continue
+                vals = np.asarray(out[col])[drop_m]
+                vals = vals[vals >= 0] if col != "ct_state_pre" else vals
+                if not vals.size:
+                    continue
+                u, c = np.unique(vals, return_counts=True)
+                order = np.argsort(c)[::-1][:4]
+                summary[key] = {int(u[i]): int(c[i]) for i in order}
             with self._lock:
                 self._verdicts.append(summary)
         except Exception:   # noqa: BLE001

@@ -1,0 +1,353 @@
+"""Vectorized flow-observe engine (the Hubble ``Observe()``/``FlowFilter``
+analog — SURVEY.md §3.6: parser → Flow record → ``Observe()`` streams).
+
+The flowlog ring is columnar (runtime/flowlog.py); this module filters it
+the same way the datapath classifies — numpy mask composition over whole
+columns, no per-row Python until a row has already matched. A query is a
+pair of filter lists exactly like Hubble's API: the **allowlist** ORs its
+filters, the **denylist** subtracts, every field inside one filter ANDs.
+
+Fields mirror Hubble's FlowFilter where this datapath has the concept:
+verdict, drop reason, endpoint, (remote) identity, protocol, ports, CIDR
+on either/src/dst address, direction — plus the ISSUE 11 provenance field
+``matched_rule`` (the resolved policy-cell coordinate), so "show me every
+flow this rule decided" is a first-class query.
+
+Two read modes:
+
+- **one-shot** (``observe``): filter the whole retained ring, newest-last,
+  bounded by ``last``.
+- **follow** (``observe(since=cursor)`` / ``FollowCursor``): seq-cursor
+  polling with *explicit gap accounting* — when the ring wraps past the
+  cursor the response carries a structured ``gap`` record (count of lost
+  rows, resume seq) and the ``flowlog_follow_gaps_total`` counter moves;
+  a follower can never silently lose records.
+
+``observe/relay.py`` fans N of these in (the hubble-relay analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cilium_tpu.runtime.flowlog import FlowLog, render_flow
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_prefix
+
+#: canonical-prefix parse, cached: follow-mode polls re-evaluate the same
+#: armed filters every cadence tick — string parsing must not be per-poll
+_parsed_prefix = lru_cache(maxsize=1024)(parse_prefix)
+
+
+@lru_cache(maxsize=1024)
+def _cidr_parts(cidr: str) -> Tuple[np.ndarray, np.ndarray]:
+    """cidr → (per-word netmask [4] uint32, masked net words [4] uint32),
+    cached so a poll pays three vector ops per armed prefix, not the
+    parse + mask construction."""
+    net16, plen, _v6 = _parsed_prefix(cidr)
+    net = np.frombuffer(net16, dtype=">u4").astype(np.uint32)
+    bits = np.clip(plen - np.arange(4) * 32, 0, 32)
+    maskw = (((1 << bits) - 1) << (32 - bits)).astype(np.uint32)
+    netm = net & maskw
+    maskw.flags.writeable = False
+    netm.flags.writeable = False
+    return maskw, netm
+
+
+def _isin(col: np.ndarray, vals: Tuple[int, ...]) -> np.ndarray:
+    """np.isin with the single-value fast path (the common filter shape —
+    one verdict, one port, one rule — where isin's sort machinery costs
+    more than the compare)."""
+    if len(vals) == 1:
+        return col == vals[0]
+    return np.isin(col, vals)
+
+_VERDICTS = ("FORWARDED", "DROPPED")
+
+#: name → drop-reason int (accepts ints too)
+_REASON_IDS = {r.name: int(r) for r in C.DropReason}
+_PROTO_IDS = {v.upper(): k for k, v in C.PROTO_NAMES.items()}
+_DIR_IDS = {"egress": C.DIR_EGRESS, "ingress": C.DIR_INGRESS}
+
+
+def _cidr_cols_mask(words: np.ndarray, cidr: str) -> np.ndarray:
+    """words [k,4] uint32 (16B normalized, v4-mapped) ∈ cidr — the
+    vectorized mirror of model.ipcache's per-prefix compare."""
+    maskw, netm = _cidr_parts(cidr)
+    return ((words & maskw) == netm).all(axis=1)
+
+
+@dataclass(frozen=True)
+class FlowFilter:
+    """One Hubble-shaped filter: every set field must match (AND); list
+    fields match any element (OR within the field). Values are
+    pre-normalized to ints/canonical prefixes by :func:`parse_filters` or
+    the constructor's callers."""
+    verdict: Optional[str] = None            # FORWARDED | DROPPED
+    reasons: Tuple[int, ...] = ()            # DropReason ints
+    endpoints: Tuple[int, ...] = ()          # local endpoint ids
+    identities: Tuple[int, ...] = ()         # remote security identities
+    protos: Tuple[int, ...] = ()             # IP protocol numbers
+    ports: Tuple[int, ...] = ()              # src OR dst port
+    sports: Tuple[int, ...] = ()
+    dports: Tuple[int, ...] = ()
+    cidrs: Tuple[str, ...] = ()              # src OR dst in any
+    src_cidrs: Tuple[str, ...] = ()
+    dst_cidrs: Tuple[str, ...] = ()
+    rules: Tuple[int, ...] = ()              # matched_rule coordinates
+    direction: Optional[int] = None
+
+    def mask(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        # lazy AND chain: the first field mask IS m (no ones() alloc +
+        # extra & per poll tick — this runs per armed filter per cadence)
+        m = None
+
+        def land(x):
+            nonlocal m
+            m = x if m is None else m & x
+
+        if self.verdict is not None:
+            land(cols["allow"] if self.verdict == "FORWARDED"
+                 else ~cols["allow"])
+        if self.reasons:
+            land(_isin(cols["reason"], self.reasons))
+        if self.endpoints:
+            land(_isin(cols["endpoint_id"], self.endpoints))
+        if self.identities:
+            land(_isin(cols["remote_identity"], self.identities))
+        if self.protos:
+            land(_isin(cols["proto"], self.protos))
+        if self.ports:
+            land(_isin(cols["sport"], self.ports)
+                 | _isin(cols["dport"], self.ports))
+        if self.sports:
+            land(_isin(cols["sport"], self.sports))
+        if self.dports:
+            land(_isin(cols["dport"], self.dports))
+        if self.rules:
+            land(_isin(cols["matched_rule"], self.rules))
+        if self.direction is not None:
+            land(cols["direction"] == self.direction)
+        for group, which in ((self.cidrs, "any"), (self.src_cidrs, "src"),
+                             (self.dst_cidrs, "dst")):
+            if not group:
+                continue
+            gm = None
+            for cidr in group:
+                if which in ("any", "src"):
+                    cm = _cidr_cols_mask(cols["src"], cidr)
+                    gm = cm if gm is None else gm | cm
+                if which in ("any", "dst"):
+                    cm = _cidr_cols_mask(cols["dst"], cidr)
+                    gm = cm if gm is None else gm | cm
+            land(gm)
+        if m is None:
+            m = np.ones(cols["seq"].shape[0], dtype=bool)
+        return m
+
+
+def compose_mask(cols: Dict[str, np.ndarray],
+                 allow: Sequence[FlowFilter] = (),
+                 deny: Sequence[FlowFilter] = ()) -> np.ndarray:
+    """Hubble semantics: OR of the allowlist (empty = everything) minus the
+    OR of the denylist."""
+    if allow:
+        m = allow[0].mask(cols)          # the common one-filter query
+        for f in allow[1:]:
+            m = m | f.mask(cols)
+    else:
+        m = np.ones(cols["seq"].shape[0], dtype=bool)
+    # NON-inplace: a single verdict-only filter's mask IS the snapshot's
+    # allow column (the lazy chain returns views when it can) — mutating
+    # it here would corrupt the very rows about to be rendered
+    for f in deny:
+        m = m & ~f.mask(cols)
+    return m
+
+
+def _ints(val, names: Optional[Dict[str, int]] = None) -> Tuple[int, ...]:
+    out = []
+    for part in str(val).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if names is not None and part.upper() in names:
+            out.append(names[part.upper()])
+        elif part.lstrip("-").isdigit():
+            out.append(int(part))
+        else:
+            raise ValueError(f"cannot parse {part!r}")
+    return tuple(out)
+
+
+def _verdict(val: str) -> str:
+    v = str(val).upper()
+    if v not in _VERDICTS:
+        raise ValueError(f"verdict must be one of {_VERDICTS}")
+    return v
+
+
+def _cidr_list(val: str) -> Tuple[str, ...]:
+    parts = tuple(p for p in str(val).split(",") if p)
+    for p in parts:
+        _parsed_prefix(p)        # bad CIDR → ValueError here (a 400), not
+    return parts                 # a 500 out of mask() at scan time
+
+
+#: query-param / CLI-flag name → FlowFilter field + value parser
+_PARAM_FIELDS = {
+    "verdict": ("verdict", _verdict),
+    "reason": ("reasons", lambda v: _ints(v, _REASON_IDS)),
+    "endpoint": ("endpoints", _ints),
+    "identity": ("identities", _ints),
+    "proto": ("protos", lambda v: _ints(v, _PROTO_IDS)),
+    "port": ("ports", _ints),
+    "sport": ("sports", _ints),
+    "dport": ("dports", _ints),
+    "cidr": ("cidrs", _cidr_list),
+    "src_cidr": ("src_cidrs", _cidr_list),
+    "dst_cidr": ("dst_cidrs", _cidr_list),
+    "rule": ("rules", _ints),
+    "direction": ("direction", lambda v: _DIR_IDS[v.lower()]),
+}
+
+
+def parse_filters(params: Dict[str, str]
+                  ) -> Tuple[List[FlowFilter], List[FlowFilter]]:
+    """Flat query params → (allowlist, denylist). Every recognized key
+    contributes to ONE allow filter (AND semantics across params, the
+    common CLI case); each ``not_``-prefixed KEY builds its own deny
+    filter, so independent exclusions OR (Hubble denylist semantics —
+    ``not_verdict=FORWARDED&not_dport=53`` excludes all FORWARDED flows
+    AND all dport-53 flows, not just their intersection). Unknown keys
+    are ignored (the API route owns its non-filter params like
+    last/since/follow)."""
+    allow_kw: Dict[str, object] = {}
+    deny_pairs: List[Tuple[str, object]] = []
+    for key, raw in params.items():
+        neg = key.startswith("not_")
+        base = key[4:] if neg else key
+        spec = _PARAM_FIELDS.get(base)
+        if spec is None:
+            if neg:
+                # a not_-prefixed key is always MEANT as a deny filter —
+                # silently dropping a typo'd one would fail open, streaming
+                # exactly the flows the operator tried to exclude
+                raise ValueError(f"unknown deny filter {key!r}")
+            continue
+        fld, parse = spec
+        try:
+            if neg and fld in ("verdict", "direction"):
+                # scalar fields don't comma-split in their parser; repeated
+                # --not flags accumulate comma-joined, so each part is its
+                # own deny filter (deny FORWARDED,DROPPED = deny both)
+                deny_pairs.extend((fld, parse(p.strip()))
+                                  for p in str(raw).split(",") if p.strip())
+            elif neg:
+                deny_pairs.append((fld, parse(raw)))
+            else:
+                allow_kw[fld] = parse(raw)
+        except (KeyError, ValueError) as e:
+            raise ValueError(f"bad filter {key}={raw!r}: {e}") from None
+    allow = [FlowFilter(**allow_kw)] if allow_kw else []
+    deny = [FlowFilter(**{fld: val}) for fld, val in deny_pairs]
+    return allow, deny
+
+
+class FlowObserver:
+    """Vectorized observe over one flowlog ring; see module docstring."""
+
+    def __init__(self, flowlog: FlowLog, metrics=None):
+        self.flowlog = flowlog
+        self.metrics = metrics
+        self.queries_total = 0
+        self.rows_scanned = 0
+        self.rows_matched = 0
+
+    def observe(self, allow: Sequence[FlowFilter] = (),
+                deny: Sequence[FlowFilter] = (),
+                last: int = 0, since: Optional[int] = None,
+                limit: int = 4096) -> Dict:
+        """One observe pass. ``since`` not None is follow mode (records
+        with seq > since, oldest first, explicit gap marker when the ring
+        wrapped past the cursor); otherwise one-shot (newest ``last``
+        matching records, oldest first). Returns {"flows", "cursor",
+        "gap", "matched", "scanned"} — ``cursor`` is the seq to poll from
+        next (in follow mode it only advances past rows actually
+        RETURNED, so a limit-truncated poll resumes without loss)."""
+        follow = since is not None
+        cols, oldest, newest = self.flowlog.snapshot_columns(
+            since_seq=since if follow else 0)
+        scanned = int(cols["seq"].shape[0])
+        # one gap contract for every follower (FlowLog.gap_marker): a real
+        # cursor the ring wrapped past gets an explicit structured marker
+        gap = self.flowlog.gap_marker(since, oldest) if follow else None
+        if scanned == 0 and gap is None:
+            # idle-poll fast path: nothing new past the cursor
+            self.queries_total += 1
+            if self.metrics is not None:
+                self.metrics.inc_counter("observer_queries_total")
+            return {"flows": [], "cursor": int(newest), "gap": None,
+                    "matched": 0, "scanned": 0}
+        m = compose_mask(cols, allow, deny)
+        idx = np.nonzero(m)[0]
+        matched = int(idx.size)
+        cap = last if (last and not follow) else limit
+        truncated = bool(cap and idx.size > cap)
+        if truncated:
+            # one-shot keeps the newest window; follow keeps the oldest
+            # (the cursor advances through the rest on the next poll)
+            idx = idx[-cap:] if not follow else idx[:cap]
+        flows = [render_flow(cols, int(j)) for j in idx]
+        if follow:
+            # advance past every row scanned UNLESS truncated — then only
+            # past the last returned row, so nothing is skipped
+            cursor = int(cols["seq"][idx[-1]]) if truncated and idx.size \
+                else int(newest)
+        else:
+            cursor = int(newest)
+        self.queries_total += 1
+        self.rows_scanned += scanned
+        self.rows_matched += matched
+        if self.metrics is not None:
+            self.metrics.inc_counter("observer_queries_total")
+            self.metrics.inc_counter("observer_rows_scanned_total", scanned)
+            self.metrics.inc_counter("observer_rows_matched_total", matched)
+        return {"flows": flows, "cursor": cursor, "gap": gap,
+                "matched": matched, "scanned": scanned}
+
+    def stats(self) -> Dict:
+        return {"queries": self.queries_total,
+                "rows_scanned": self.rows_scanned,
+                "rows_matched": self.rows_matched,
+                "follow_gaps": self.flowlog.follow_gaps,
+                "follow_gap_records": self.flowlog.follow_gap_records}
+
+
+@dataclass
+class FollowCursor:
+    """Follow-mode convenience: holds the seq cursor and the armed filters;
+    each :meth:`poll` returns new matching flows (gap marker included as a
+    leading record when the ring wrapped past us)."""
+    observer: FlowObserver
+    allow: Sequence[FlowFilter] = ()
+    deny: Sequence[FlowFilter] = ()
+    cursor: int = 0                # seq of the last record consumed
+    gaps: int = 0
+    dropped: int = 0
+
+    def poll(self, limit: int = 4096) -> List[Dict]:
+        res = self.observer.observe(self.allow, self.deny,
+                                    since=self.cursor, limit=limit)
+        out: List[Dict] = []
+        if res["gap"] is not None:
+            self.gaps += 1
+            self.dropped += res["gap"]["dropped"]
+            out.append(res["gap"])
+        out.extend(res["flows"])
+        self.cursor = res["cursor"]
+        return out

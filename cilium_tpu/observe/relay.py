@@ -1,0 +1,132 @@
+"""Flow relay: hubble-relay-style fan-in over N observer rings
+(SURVEY.md §3.6; ROADMAP item 3's aggregation point).
+
+A relay owns one :class:`~cilium_tpu.observe.observer.FlowObserver` per
+named source (today: the engines of a sharded single-host mesh or N local
+engine processes' flowlogs; tomorrow: the per-host observers of the
+clustermesh tier — the merge logic is source-agnostic, so the multi-host
+PR swaps transports, not this code) and serves the same two read modes the
+single observer does:
+
+- **one-shot** (:meth:`observe`): query every source with the same
+  allow/deny filter lists, k-way merge on ``(time, seq)`` (each source's
+  seq is monotonic, so the merge is a mergesort of already-sorted runs),
+  newest-last, each record tagged ``node=<source>``.
+- **follow** (:meth:`poll`): per-source seq cursors advance independently;
+  each poll merges the new records and *re-emits every source's gap
+  markers* (tagged with the node) — fan-in never hides loss.
+
+Per-source lag is a first-class gauge: ``relay_source_lag{source=...}`` is
+how many records a source has appended past the relay's cursor
+(post-poll it reads 0 unless the poll truncated), the "is one host
+falling behind" signal an operator watches before a multi-host follower
+starts dropping.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Sequence
+
+from cilium_tpu.observe.observer import FlowFilter, FlowObserver
+from cilium_tpu.runtime.flowlog import FlowLog
+
+
+class FlowRelay:
+    def __init__(self, sources: Dict[str, object], metrics=None):
+        """``sources``: name → FlowObserver | FlowLog (flowlogs are
+        wrapped). Names become the ``node`` tag on merged records."""
+        self.observers: Dict[str, FlowObserver] = {}
+        for name, src in sources.items():
+            if isinstance(src, FlowLog):
+                src = FlowObserver(src)
+            self.observers[name] = src
+        self.metrics = metrics
+        self._cursors: Dict[str, int] = {name: 0 for name in self.observers}
+        self.polls_total = 0
+        self.gaps_total = 0
+
+    # -- merge core -----------------------------------------------------------
+    @staticmethod
+    def _merge(per_source: Dict[str, List[Dict]]) -> List[Dict]:
+        """k-way mergesort on (time, seq, node): each source list is
+        already seq-sorted (ring order), so heapq.merge is O(total·log k).
+        Gap markers carry no time — they sort at the head of their
+        source's run (loss is announced before the records after it)."""
+        runs = []
+        for name, flows in per_source.items():
+            run = []
+            for r in flows:
+                r = dict(r)
+                r["node"] = name
+                run.append(r)
+            runs.append(run)
+        return list(heapq.merge(
+            *runs, key=lambda r: (r.get("time", -1), r.get("seq", -1),
+                                  r.get("node", ""))))
+
+    def observe(self, allow: Sequence[FlowFilter] = (),
+                deny: Sequence[FlowFilter] = (), last: int = 0) -> Dict:
+        """One-shot fan-in: same filters against every source, merged
+        newest-last, bounded by ``last`` AFTER the merge (so the window is
+        global, not per-source)."""
+        per, stats = {}, {}
+        for name, obs in self.observers.items():
+            # last=0 means the full retained window: lift the observer's
+            # default one-shot cap to the source ring's size so "bounded
+            # AFTER the merge" holds (no silent per-source truncation)
+            res = obs.observe(allow, deny, last=last,
+                              limit=max(len(obs.flowlog), 1))
+            per[name] = res["flows"]
+            stats[name] = {"matched": res["matched"],
+                           "scanned": res["scanned"],
+                           "newest_seq": res["cursor"]}
+        merged = self._merge(per)
+        if last and len(merged) > last:
+            merged = merged[-last:]
+        return {"flows": merged, "sources": stats}
+
+    def poll(self, allow: Sequence[FlowFilter] = (),
+             deny: Sequence[FlowFilter] = (),
+             limit: int = 4096) -> Dict:
+        """Follow-mode fan-in: advance every source's cursor, merge the new
+        records, surface every gap, export per-source lag gauges."""
+        per: Dict[str, List[Dict]] = {}
+        gaps: List[Dict] = []
+        lags: Dict[str, int] = {}
+        for name, obs in self.observers.items():
+            res = obs.observe(allow, deny, since=self._cursors[name],
+                              limit=limit)
+            self._cursors[name] = res["cursor"]
+            run = []
+            if res["gap"] is not None:
+                g = dict(res["gap"])
+                g["node"] = name
+                gaps.append(g)
+                self.gaps_total += 1
+                run.append(g)
+            run.extend(res["flows"])
+            per[name] = run
+            lag = max(0, obs.flowlog.newest_seq - self._cursors[name])
+            lags[name] = lag
+            if self.metrics is not None:
+                self.metrics.set_gauge(
+                    f'relay_source_lag{{source="{name}"}}', lag)
+        self.polls_total += 1
+        if self.metrics is not None:
+            self.metrics.inc_counter("relay_polls_total")
+            if gaps:
+                self.metrics.inc_counter("relay_source_gaps_total",
+                                         len(gaps))
+        return {"flows": self._merge(per), "gaps": gaps, "lag": lags}
+
+    def cursors(self) -> Dict[str, int]:
+        return dict(self._cursors)
+
+    def stats(self) -> Dict:
+        return {
+            "sources": sorted(self.observers),
+            "cursors": dict(self._cursors),
+            "polls": self.polls_total,
+            "gaps": self.gaps_total,
+        }

@@ -341,7 +341,8 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                    "http_path", "valid")}
     out_spec = {k: P("flows") for k in
                 ("allow", "reason", "status", "ct_full", "remote_identity",
-                 "redirect", "svc", "nat_dst", "nat_dport", "rnat",
+                 "redirect", "matched_rule", "lpm_prefix", "ct_state_pre",
+                 "svc", "nat_dst", "nat_dport", "rnat",
                  "rnat_src", "rnat_sport")}
     counters_spec = {"by_reason_dir": P(), "insert_fail": P(),
                      "ct_evicted": P()}

@@ -20,6 +20,19 @@ Routes (all under /v1):
   GET  /v1/services           service/LB state
   GET  /v1/ct?limit=N&now=T   live conntrack entries
   GET  /v1/flows?last=N&verdict=V   flow log tail
+  GET  /v1/flows/observe      vectorized filtered observe (the Hubble
+                              Observe()/FlowFilter analog,
+                              observe/observer.py): allow-filter params
+                              verdict/reason/endpoint/identity/proto/
+                              port/sport/dport/cidr/src_cidr/dst_cidr/
+                              rule/direction (comma-lists OR within a
+                              field, fields AND; ``not_``-prefixed params
+                              build the denylist), last=N one-shot window,
+                              since=SEQ follow mode with a structured
+                              ``gap`` record on ring wraparound,
+                              explain=1 attaches the provenance legend
+                              (matched rule → id/port class + identity,
+                              lpm_prefix → canonical ipcache prefix)
   GET  /v1/flows/metrics?last=N     windowed flow-metrics time-series +
                               cumulative totals (the hubble metrics analog)
   GET  /v1/trace?limit=N&name=S     sampled span ring + per-stage summary
@@ -59,6 +72,7 @@ import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler
 from typing import TYPE_CHECKING, Dict, Optional, Tuple
+from urllib.parse import unquote
 
 from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
@@ -187,6 +201,9 @@ def status_doc(engine: "Engine") -> Dict:
         # verdict provenance: parity-audit counters + flight-recorder state
         "audit": engine.auditor.stats(),
         "blackbox": engine.blackbox.stats(),
+        # vectorized flow-observe engine (observe/observer.py): query +
+        # follow-gap accounting over the columnar flowlog ring
+        "observer": engine.observer.stats(),
     }
 
 
@@ -422,7 +439,15 @@ class _Handler(BaseHTTPRequestHandler):
         for part in query.split("&"):
             if "=" in part:
                 k, _, v = part.partition("=")
-                params[k] = v
+                # the observe CLI percent-encodes filter values (CIDRs
+                # carry '/'); decode so filters see the literal value
+                k, v = unquote(k), unquote(v)
+                if k.startswith("not_") and k in params:
+                    # repeatable --not flags: same-key denies accumulate
+                    # (the filter parsers comma-split multi-values)
+                    params[k] += "," + v
+                else:
+                    params[k] = v
         return path.rstrip("/"), params
 
     # -- methods ------------------------------------------------------------
@@ -467,6 +492,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, ct_doc(
                     eng, int(q.get("limit", 64)),
                     int(q["now"]) if "now" in q else None))
+            if path == "/v1/flows/observe":
+                from cilium_tpu.observe.observer import parse_filters
+                try:
+                    allow, deny = parse_filters(q)
+                except ValueError as e:
+                    return self._send_json(400, {"error": str(e)})
+                res = eng.observer.observe(
+                    allow, deny,
+                    last=int(q.get("last", 0)),
+                    since=int(q["since"]) if "since" in q else None,
+                    limit=int(q.get("limit", 4096)))
+                if q.get("explain") in ("1", "true"):
+                    res["legend"] = eng.explain_provenance(res["flows"])
+                return self._send_json(200, res)
             if path == "/v1/flows/metrics":
                 return self._send_json(200, {
                     "windows": eng.flowmetrics.series(

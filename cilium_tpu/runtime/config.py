@@ -137,6 +137,10 @@ class DaemonConfig:
     flowlog_path: str = ""         # JSONL sink ("" = in-memory ring only)
     metrics_path: str = ""         # Prometheus text file ("" = disabled)
     obs_flush_interval_s: float = 5.0
+    # capped {rule=} label cardinality for policy_rule_{hits,drops}_total:
+    # a 50k-rule world must not mint 50k Prometheus series; coordinates
+    # past the cap aggregate under rule="other" (0 disables the family)
+    rule_metrics_max: int = 128
     # --- observe/: tracing, flow metrics, autotune ---
     trace_sample_rate: float = 0.0   # 0 off; 1/64 samples every 64th event
     trace_capacity: int = 4096       # span ring size

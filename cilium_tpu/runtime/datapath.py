@@ -1051,6 +1051,12 @@ class FakeDatapath(DatapathBackend):
                 "ct_full": np.zeros(n, bool),
                 "remote_identity": np.zeros(n, np.int32),
                 "redirect": np.zeros(n, bool),
+                # provenance columns (invalid rows: -1 like the device's
+                # valid mask; ct_state_pre 0 == CTStatus.NEW, matching the
+                # kernel's valid-masked est/reply)
+                "matched_rule": np.full(n, -1, np.int32),
+                "lpm_prefix": np.full(n, -1, np.int32),
+                "ct_state_pre": np.zeros(n, np.int32),
                 "svc": np.zeros(n, bool),
                 "nat_dst": np.zeros((n, 4), np.uint32),
                 "nat_dport": np.zeros(n, np.int32),
@@ -1070,6 +1076,9 @@ class FakeDatapath(DatapathBackend):
                 out["ct_full"][i] = v.ct_full
                 out["remote_identity"][i] = v.remote_identity
                 out["redirect"][i] = v.redirect
+                out["matched_rule"][i] = v.matched_rule
+                out["lpm_prefix"][i] = v.lpm_prefix
+                out["ct_state_pre"][i] = v.ct_status
                 out["svc"][i] = v.svc
                 if v.nat_dst:
                     out["nat_dst"][i] = np.frombuffer(v.nat_dst, dtype=">u4")

@@ -86,7 +86,20 @@ class Engine:
         self.metrics = Metrics()
         self.flowlog = FlowLog(self.config.flowlog_capacity,
                                self.config.flowlog_mode,
-                               sink_path=self.config.flowlog_path or None)
+                               sink_path=self.config.flowlog_path or None,
+                               metrics=self.metrics)
+        # the vectorized flow-observe engine over the columnar ring
+        # (observe/observer.py — the Hubble Observe()/FlowFilter analog);
+        # /v1/flows/observe and `cilium-tpu observe` serve through it
+        from cilium_tpu.observe.observer import FlowObserver
+        self.observer = FlowObserver(self.flowlog, metrics=self.metrics)
+        # per-rule hit/drop counters (the unused-rule / policy-drift
+        # signal): matched_rule provenance → capped-cardinality labeled
+        # counters, resolved to rule tags lazily per snapshot
+        self._rule_fold_lock = threading.Lock()
+        self._rule_label_memo: Dict[int, str] = {}   # coord → label
+        self._rule_label_snap: Optional[PolicySnapshot] = None
+        self._rule_labels_seen: set = set()          # global cardinality cap
         # observe/: span tracer + Hubble-metrics-analog windowed flow
         # aggregation. The tracer is process-wide; an engine only
         # configures it when ITS config turns tracing on — constructing a
@@ -462,6 +475,115 @@ class Engine:
         by its own observers."""
         self.blackbox.record_verdicts(out, n_valid, now)
         self.auditor.maybe_capture(batch, out, snap, now, steered=steered)
+        self._fold_rule_hits(out, snap)
+
+    # -- per-rule hit/drop counters (ISSUE 11) ----------------------------------
+    def _fold_rule_hits(self, out, snap) -> None:
+        """matched_rule provenance → ``policy_rule_hits_total{rule=...}`` /
+        ``policy_rule_drops_total{rule=...}``: the unused-rule /
+        policy-drift signal. Vectorized (one bincount pass per verdict
+        class, Python only over the batch's DISTINCT coordinates — a batch
+        matches a handful of rules, not a handful of thousands) and
+        capped-cardinality (``rule_metrics_max`` distinct label values
+        process-wide, overflow under ``rule="other"``). Never-raise: the
+        serving path cannot be taken down by its own observers."""
+        cap = self.config.rule_metrics_max
+        if cap <= 0 or not isinstance(out, dict) \
+                or "matched_rule" not in out:
+            return
+        try:
+            mr = np.asarray(out["matched_rule"])
+            allow = np.asarray(out["allow"])
+            ran = mr >= 0
+            if not bool(ran.any()):
+                return
+            coords = mr[ran].astype(np.int64)
+            allowed = allow[ran]
+            # bincount over unique-compacted indices: O(batch), never
+            # O(max coordinate) — a 50k-rule world's cell space would
+            # otherwise allocate megabyte count arrays per finalized batch
+            uniq, inv = np.unique(coords, return_inverse=True)
+            hits = np.bincount(inv[allowed], minlength=uniq.size)
+            drops = np.bincount(inv[~allowed], minlength=uniq.size)
+            with self._rule_fold_lock:
+                if snap is not self._rule_label_snap:
+                    # labels are coordinate-space-relative; a new snapshot
+                    # re-resolves (already-seen label STRINGS keep their
+                    # series — the cap is on strings, not snapshots)
+                    self._rule_label_memo.clear()
+                    self._rule_label_snap = snap
+                for u in range(uniq.size):
+                    c = int(uniq[u])
+                    label = self._rule_label_memo.get(c)
+                    if label is None:
+                        label = self._resolve_rule_label(c, snap, cap)
+                        self._rule_label_memo[c] = label
+                    if hits[u]:
+                        self.metrics.inc_counter(
+                            f'policy_rule_hits_total{{rule="{label}"}}',
+                            int(hits[u]))
+                    if drops[u]:
+                        self.metrics.inc_counter(
+                            f'policy_rule_drops_total{{rule="{label}"}}',
+                            int(drops[u]))
+        except Exception:   # noqa: BLE001
+            log.exception("per-rule hit fold failed")
+            self.metrics.inc_counter("rule_metrics_errors_total")
+
+    def _resolve_rule_label(self, coord: int, snap, cap: int) -> str:
+        """One matched_rule coordinate → its stable label:
+        ``ic<id_class>/pc<port_class>[/id<representative identity>]``.
+        Holds ``_rule_fold_lock``."""
+        npc = max(1, snap.port_classes.n_classes)
+        ic, pc = divmod(coord, npc)
+        label = f"ic{ic}/pc{pc}"
+        if 0 <= ic < snap.id_classes.n_classes:
+            rep = int(snap.id_classes.representative[ic])
+            if rep >= 0:
+                label += f"/id{rep}"
+        if label not in self._rule_labels_seen:
+            if len(self._rule_labels_seen) >= cap:
+                return "other"
+            self._rule_labels_seen.add(label)
+        return label
+
+    def explain_provenance(self, flows: Sequence[Dict]) -> Dict:
+        """Legend for the provenance coordinates in ``flows`` (observe API
+        / CLI): matched_rule → id-class/port-class/representative-identity,
+        lpm_prefix → the canonical ipcache prefix — resolved against the
+        ACTIVE snapshot. Records predating the current revision may name
+        coordinates the legend cannot resolve; they return ``resolved:
+        False`` rather than a wrong answer."""
+        active = self.active
+        snap = active.snapshot if active is not None else None
+        rules: Dict[str, Dict] = {}
+        prefixes: Dict[str, Dict] = {}
+        for r in flows:
+            mr = int(r.get("matched_rule", -1))
+            if mr >= 0 and str(mr) not in rules:
+                if snap is not None:
+                    npc = max(1, snap.port_classes.n_classes)
+                    ic, pc = divmod(mr, npc)
+                    ok = 0 <= ic < snap.id_classes.n_classes
+                    rep = int(snap.id_classes.representative[ic]) \
+                        if ok else -1
+                    rules[str(mr)] = {
+                        "resolved": ok, "id_class": ic, "port_class": pc,
+                        "rep_identity": rep,
+                        "label": self._rule_label_memo.get(
+                            mr, f"ic{ic}/pc{pc}")}
+                else:
+                    rules[str(mr)] = {"resolved": False}
+            lp = int(r.get("lpm_prefix", -1))
+            if lp >= 0 and str(lp) not in prefixes:
+                if snap is not None:
+                    d = snap.lpm.describe(lp)
+                    d["resolved"] = d["prefix"] is not None
+                    prefixes[str(lp)] = d
+                else:
+                    prefixes[str(lp)] = {"resolved": False}
+        return {"rules": rules, "prefixes": prefixes,
+                "revision": active.revision if active is not None else -1}
 
     def _on_parity_mismatch(self, detail: Dict) -> None:
         """Auditor mismatch sink: narrate to the flight recorder (which

@@ -1,8 +1,22 @@
 """Flow log ring (Hubble-lite: SURVEY.md §2 "Minimal analog: flow log with
-identity/verdict annotation"). Fixed-capacity host ring buffer of flow
-records appended per batch; renderable as JSON lines for the CLI, with an
-optional JSONL file sink (the ``hubble export`` analog) that the
-``cilium-tpu monitor`` command reads.
+identity/verdict annotation").
+
+Columnar since ISSUE 11: the ring is a struct-of-arrays over fixed-capacity
+numpy columns — one vectorized slice write per appended batch instead of a
+Python dict per record, and the provenance columns the classify interior now
+emits (``matched_rule``, ``lpm_prefix``, ``ct_state_pre``) ride along at
+int32 cost. Dict records are *rendered on demand* (tail/since/sink/observer
+hits), so the hot append path never materializes them; the vectorized
+observer (observe/observer.py) filters straight on the column arrays with
+numpy masks and renders only matching rows.
+
+Follow-mode loss is explicit: ``since()`` prepends a structured
+``{"gap": True, "dropped": N}`` marker (and counts
+``flowlog_follow_gaps_total``) whenever the cursor predates the oldest
+retained record — a follower can never silently skip a wraparound.
+
+Renderable as JSON lines for the CLI, with an optional JSONL file sink (the
+``hubble export`` analog) that the ``cilium-tpu monitor`` command reads.
 """
 
 from __future__ import annotations
@@ -10,12 +24,12 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from cilium_tpu.utils import constants as C
-from cilium_tpu.utils.ip import addr_to_str, words_to_addr
+from cilium_tpu.utils.ip import addr_to_str, parse_addr, words_to_addr
 
 SINK_ROTATE_BYTES = 64 << 20      # rotate the JSONL sink at 64MB (keep .1)
 SINK_BUF_MAX = 65536              # cap pending sink lines (drop-oldest)
@@ -25,22 +39,134 @@ APPEND_BATCH_MAX = 4096           # records extracted per batch (keep newest)
 # a drop storm; these are the hot-path equivalents of DropReason(x).name
 _REASON_NAMES = {int(r): r.name for r in C.DropReason}
 _STATUS_NAMES = {int(s): s.name for s in C.CTStatus}
+_NAME_TO_STATUS = {v: k for k, v in _STATUS_NAMES.items()}
+_NAME_TO_PROTO = {v: k for k, v in C.PROTO_NAMES.items()}
+_NAME_TO_DIR = {v: k for k, v in C.DIR_NAMES.items()}
+#: rendered field → raw int column for the exact-match read filters
+_INT_FILTER_COLS = {"drop_reason": "reason", "src_port": "sport",
+                    "dst_port": "dport", "endpoint_id": "endpoint_id",
+                    "remote_identity": "remote_identity",
+                    "matched_rule": "matched_rule",
+                    "lpm_prefix": "lpm_prefix", "seq": "seq", "time": "time"}
+
+
+def _filter_mask(cols: Dict[str, np.ndarray], filters: Dict
+                 ) -> Tuple[np.ndarray, Dict]:
+    """Vectorized pre-filter for the ``tail()``/``since()`` exact-match
+    surface: maps rendered-field filters (verdict=, dst_port=, src_ip=,
+    ...) onto raw-column comparisons so only matching rows are rendered
+    to dicts — not the whole retained ring per query. Returns ``(mask,
+    residual)``; residual keys (unknown fields, off-domain values) still
+    dict-match post-render, preserving the original semantics exactly."""
+    m = np.ones(int(cols["seq"].shape[0]), dtype=bool)
+    residual: Dict = {}
+    for k, v in filters.items():
+        if k == "verdict" and v in ("FORWARDED", "DROPPED"):
+            m = m & (cols["allow"] == (v == "FORWARDED"))
+        elif k in _INT_FILTER_COLS and isinstance(v, int) \
+                and not isinstance(v, bool):
+            m = m & (cols[_INT_FILTER_COLS[k]] == v)
+        elif k in ("ct_state", "ct_state_pre") and v in _NAME_TO_STATUS:
+            col = "status" if k == "ct_state" else "ct_state_pre"
+            m = m & (cols[col] == _NAME_TO_STATUS[v])
+        elif k == "proto" and v in _NAME_TO_PROTO:
+            m = m & (cols["proto"] == _NAME_TO_PROTO[v])
+        elif k == "direction" and v in _NAME_TO_DIR:
+            m = m & (cols["direction"] == _NAME_TO_DIR[v])
+        elif k in ("src_ip", "dst_ip"):
+            try:
+                a16, _fam = parse_addr(str(v))
+            except (ValueError, OSError):
+                residual[k] = v          # unparseable → matches nothing,
+                continue                 # same as the rendered compare
+            w = np.frombuffer(a16, dtype=">u4").astype(np.uint32)
+            m = m & (cols[k[:3]] == w).all(axis=1)
+        else:
+            residual[k] = v
+    return m, residual
+
+#: scalar int32 ring columns filled straight from batch/out arrays.
+#: Physically they share ONE [capacity, len] int32 matrix (with the int64
+#: pair seq/time, the 8-word src+dst block and the allow bools as three
+#: more): a ring append or a snapshot window is then 4 contiguous slice
+#: ops instead of 18 per-column ones — the difference between a follow
+#: poll costing ~10us and ~30us. Readers still see a per-name dict; the
+#: values are column VIEWS into the copied blocks.
+_I32_COLS = ("reason", "status", "matched_rule", "lpm_prefix",
+             "remote_identity", "sport", "dport", "proto", "direction",
+             "endpoint_id", "ct_state_pre")
+_I32_AT = {name: j for j, name in enumerate(_I32_COLS)}
+
+
+def render_flow(cols: Dict[str, np.ndarray], j: int) -> Dict:
+    """One ring row → the wire-format record dict (the shape the API/CLI
+    and the JSONL sink have always used, plus the provenance fields)."""
+    r = int(cols["reason"][j])
+    s = int(cols["status"][j])
+    return {
+        "time": int(cols["time"][j]),
+        "verdict": "FORWARDED" if cols["allow"][j] else "DROPPED",
+        "drop_reason": r,
+        "drop_reason_desc": _REASON_NAMES.get(r, str(r)),
+        "ct_state": _STATUS_NAMES.get(s, str(s)),
+        "src_ip": addr_to_str(words_to_addr(cols["src"][j])),
+        "dst_ip": addr_to_str(words_to_addr(cols["dst"][j])),
+        "src_port": int(cols["sport"][j]),
+        "dst_port": int(cols["dport"][j]),
+        "proto": C.PROTO_NAMES.get(int(cols["proto"][j]),
+                                   str(int(cols["proto"][j]))),
+        "direction": C.DIR_NAMES[int(cols["direction"][j])],
+        "endpoint_id": int(cols["endpoint_id"][j]),
+        "remote_identity": int(cols["remote_identity"][j]),
+        # match provenance (ISSUE 11): the evidence behind the verdict —
+        # resolved policy-cell coordinate, packed winning-prefix slot/len,
+        # CT probe class as-of classification
+        "matched_rule": int(cols["matched_rule"][j]),
+        "lpm_prefix": int(cols["lpm_prefix"][j]),
+        "ct_state_pre": _STATUS_NAMES.get(
+            int(cols["ct_state_pre"][j]), str(int(cols["ct_state_pre"][j]))),
+        "seq": int(cols["seq"][j]),
+    }
 
 
 class FlowLog:
     def __init__(self, capacity: int = 16384, mode: str = "drops",
-                 sink_path: Optional[str] = None):
+                 sink_path: Optional[str] = None, metrics=None):
         self.capacity = capacity
         self.mode = mode
         self.sink_path = sink_path
+        self.metrics = metrics         # optional runtime/metrics.Metrics
         self._lock = threading.Lock()
-        self._ring: List[Dict] = []
-        self._next = 0
+        # the four packed ring blocks (see _I32_COLS note above)
+        self._i32 = np.zeros((capacity, len(_I32_COLS)), dtype=np.int32)
+        self._i64 = np.zeros((capacity, 2), dtype=np.int64)   # seq, time
+        self._addr = np.zeros((capacity, 8), dtype=np.uint32)  # src+dst
+        self._allow = np.zeros(capacity, dtype=bool)
+        # shared zero-row view per column: the idle-poll fast path (a
+        # follow cadence mostly finds nothing new; fresh empty copies per
+        # tick would dominate an idle follower's cost)
+        self._empty_cols = self._as_cols(self._i32[:0], self._i64[:0],
+                                         self._addr[:0], self._allow[:0])
+        self._next = 0                 # slot the next record lands in
+        self._count = 0                # live records (<= capacity)
         self._seq = 0                  # monotonic record id (live follow)
         self._sink_buf: List[str] = []
         self.sink_dropped = 0          # lines shed when _sink_buf hit its cap
         self.extract_shed = 0          # records past APPEND_BATCH_MAX per batch
+        self.follow_gaps = 0           # since() cursors that crossed a wrap
+        self.follow_gap_records = 0    # records those cursors lost
         self.total_seen = 0
+
+    @staticmethod
+    def _as_cols(i32: np.ndarray, i64: np.ndarray, addr: np.ndarray,
+                 allow: np.ndarray) -> Dict[str, np.ndarray]:
+        """Per-name column views over the packed blocks (the read/render
+        schema every consumer sees)."""
+        cols = {"seq": i64[:, 0], "time": i64[:, 1], "allow": allow,
+                "src": addr[:, :4], "dst": addr[:, 4:]}
+        for name, j in _I32_AT.items():
+            cols[name] = i32[:, j]
+        return cols
 
     def append_batch(self, batch: Dict[str, np.ndarray],
                      out: Dict[str, np.ndarray], now: int,
@@ -48,9 +174,6 @@ class FlowLog:
         if self.mode == "none":
             return
         allow = np.asarray(out["allow"])
-        reason = np.asarray(out["reason"])
-        status = np.asarray(out["status"])
-        rid = np.asarray(out["remote_identity"])
         valid = np.asarray(batch["valid"])
         if self.mode == "drops":
             pick = valid & ~allow
@@ -61,56 +184,79 @@ class FlowLog:
         if idxs.size == 0:
             return
         if idxs.size > APPEND_BATCH_MAX:
-            # a drop storm can select a whole 64k batch; extracting dicts
+            # a drop storm can select a whole 64k batch; extracting columns
             # for all of it would dominate the pipelined hot path. Keep the
             # newest rows (the ring is drop-oldest anyway) and account.
             self.extract_shed += int(idxs.size) - APPEND_BATCH_MAX
             idxs = idxs[-APPEND_BATCH_MAX:]
-        # hot fields pulled column-wise in one vectorized gather each —
-        # per-element numpy scalar indexing was the dominant cost here
-        allow_l = allow[idxs].tolist()
-        reason_l = reason[idxs].tolist()
-        status_l = status[idxs].tolist()
-        rid_l = rid[idxs].tolist()
-        sport_l = np.asarray(batch["sport"])[idxs].tolist()
-        dport_l = np.asarray(batch["dport"])[idxs].tolist()
-        proto_l = np.asarray(batch["proto"])[idxs].tolist()
-        dir_l = np.asarray(batch["direction"])[idxs].tolist()
-        slot_l = np.asarray(batch["ep_slot"])[idxs].tolist()
-        src_rows = np.asarray(batch["src"])[idxs]
-        dst_rows = np.asarray(batch["dst"])[idxs]
-        now = int(now)
-        n_eps = len(ep_ids)
-        records = []
-        for j in range(len(allow_l)):
-            ep_slot = slot_l[j]
-            r, s = reason_l[j], status_l[j]
-            records.append({
-                "time": now,
-                "verdict": "FORWARDED" if allow_l[j] else "DROPPED",
-                "drop_reason": r,
-                "drop_reason_desc": _REASON_NAMES.get(r, str(r)),
-                "ct_state": _STATUS_NAMES.get(s, str(s)),
-                "src_ip": addr_to_str(words_to_addr(src_rows[j])),
-                "dst_ip": addr_to_str(words_to_addr(dst_rows[j])),
-                "src_port": sport_l[j],
-                "dst_port": dport_l[j],
-                "proto": C.PROTO_NAMES.get(proto_l[j], str(proto_l[j])),
-                "direction": C.DIR_NAMES[dir_l[j]],
-                "endpoint_id": ep_ids[ep_slot] if ep_slot < n_eps else -1,
-                "remote_identity": rid_l[j],
-            })
+        k = idxs.size
+        # columnar extraction: one vectorized gather per column, no
+        # per-record Python. Unknown endpoint slots map to -1 exactly like
+        # the old per-record rendering did.
+        ep_lut = np.fromiter(ep_ids, dtype=np.int64,
+                             count=len(ep_ids)) if ep_ids else \
+            np.zeros(0, dtype=np.int64)
+        slots = np.asarray(batch["ep_slot"])[idxs].astype(np.int64)
+        known = (slots >= 0) & (slots < ep_lut.shape[0])
+        ep_col = np.where(known, ep_lut[np.clip(slots, 0,
+                                                max(0, ep_lut.shape[0] - 1))]
+                          if ep_lut.size else -1, -1)
+        at = _I32_AT
+        st_i32 = np.empty((k, len(_I32_COLS)), dtype=np.int32)
+        st_i32[:, at["reason"]] = np.asarray(out["reason"])[idxs]
+        status = np.asarray(out["status"])[idxs]
+        st_i32[:, at["status"]] = status
+        st_i32[:, at["matched_rule"]] = np.asarray(
+            out["matched_rule"])[idxs] if "matched_rule" in out else -1
+        st_i32[:, at["lpm_prefix"]] = np.asarray(
+            out["lpm_prefix"])[idxs] if "lpm_prefix" in out else -1
+        st_i32[:, at["ct_state_pre"]] = np.asarray(
+            out["ct_state_pre"])[idxs] if "ct_state_pre" in out else status
+        st_i32[:, at["remote_identity"]] = \
+            np.asarray(out["remote_identity"])[idxs]
+        st_i32[:, at["sport"]] = np.asarray(batch["sport"])[idxs]
+        st_i32[:, at["dport"]] = np.asarray(batch["dport"])[idxs]
+        st_i32[:, at["proto"]] = np.asarray(batch["proto"])[idxs]
+        st_i32[:, at["direction"]] = np.asarray(batch["direction"])[idxs]
+        st_i32[:, at["endpoint_id"]] = ep_col
+        st_i64 = np.empty((k, 2), dtype=np.int64)
+        st_i64[:, 1] = int(now)
+        st_addr = np.empty((k, 8), dtype=np.uint32)
+        st_addr[:, :4] = np.asarray(batch["src"])[idxs]
+        st_addr[:, 4:] = np.asarray(batch["dst"])[idxs]
+        st_allow = allow[idxs]
+        cap = self.capacity
         with self._lock:
-            for rec in records:
-                self._seq += 1
-                rec["seq"] = self._seq
-                if len(self._ring) < self.capacity:
-                    self._ring.append(rec)
-                else:
-                    self._ring[self._next] = rec
-                self._next = (self._next + 1) % self.capacity
-            if self.sink_path is not None:
-                self._sink_buf.extend(json.dumps(r) for r in records)
+            seq0 = self._seq + 1
+            self._seq += k
+            st_i64[:, 0] = np.arange(seq0, seq0 + k, dtype=np.int64)
+            if k > cap:
+                # one batch larger than the whole ring: only the newest
+                # ``cap`` records survive the wrap anyway — trim the head
+                # (their seqs are consumed, so followers see the loss as a
+                # gap) and keep the two-slice write below correct
+                st_i32, st_i64 = st_i32[k - cap:], st_i64[k - cap:]
+                st_addr, st_allow = st_addr[k - cap:], st_allow[k - cap:]
+                k = cap
+            pos = self._next
+            # wraparound = at most two contiguous slice writes per block
+            first = min(k, cap - pos)
+            for ring, st in ((self._i32, st_i32), (self._i64, st_i64),
+                             (self._addr, st_addr), (self._allow, st_allow)):
+                ring[pos:pos + first] = st[:first]
+                if first < k:
+                    ring[: k - first] = st[first:]
+            self._next = (pos + k) % cap
+            self._count = min(cap, self._count + k)
+        if self.sink_path is not None:
+            # render/dumps OUTSIDE the ring lock: the staged columns are
+            # call-local and seq-stamped, and a 4096-record drop storm's
+            # string formatting must not block every snapshot_columns
+            # reader (observer polls, /v1/flows, relay) for its duration
+            staged = self._as_cols(st_i32, st_i64, st_addr, st_allow)
+            lines = [json.dumps(render_flow(staged, j)) for j in range(k)]
+            with self._lock:
+                self._sink_buf.extend(lines)
                 # Bound host memory if flush_sink isn't running (engine used
                 # without controllers, or drop storms outpacing the flush
                 # interval): shed oldest, count the shed.
@@ -118,6 +264,111 @@ class FlowLog:
                 if excess > 0:
                     del self._sink_buf[:excess]
                     self.sink_dropped += excess
+
+    # -- columnar access (observe/observer.py) --------------------------------
+    def snapshot_columns(self, since_seq: int = 0
+                         ) -> Tuple[Dict[str, np.ndarray], int, int]:
+        """Consistent copy of the retained columns oldest→newest, restricted
+        to records with seq > ``since_seq``. Returns (cols, oldest_seq,
+        newest_seq) where oldest_seq is the first seq still retained (0 when
+        empty) — the caller derives gap accounting from it. This is the
+        vectorized read surface: one concatenate per column, no dicts."""
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return (self._empty_cols, 0, self._seq)
+            start = (self._next - n) % self.capacity
+            oldest = self._seq - n + 1
+            skip = 0
+            if since_seq >= oldest:
+                skip = min(n, int(since_seq - oldest + 1))
+            take = n - skip
+            if take <= 0:
+                return (self._empty_cols, oldest, self._seq)
+            s = (start + skip) % self.capacity
+            first = min(take, self.capacity - s)
+
+            def cut(ring):
+                if first < take:
+                    return np.concatenate(
+                        [ring[s:s + first], ring[: take - first]])
+                return ring[s:s + first].copy()
+            return (self._as_cols(cut(self._i32), cut(self._i64),
+                                  cut(self._addr), cut(self._allow)),
+                    oldest, self._seq)
+
+    def _note_gap(self, dropped: int) -> None:
+        self.follow_gaps += 1
+        self.follow_gap_records += dropped
+        if self.metrics is not None:
+            self.metrics.inc_counter("flowlog_follow_gaps_total")
+            self.metrics.inc_counter("flowlog_follow_gap_records_total",
+                                     dropped)
+
+    def gap_marker(self, since: int, oldest: int) -> Optional[Dict]:
+        """The ONE definition of the follow-gap contract, shared by
+        ``since()`` and the observer: a cursor that predates the oldest
+        retained record lost ``oldest - since - 1`` records to ring
+        wraparound → a structured ``{"gap": True, "dropped": N,
+        "resume_seq": S}`` marker (counted via ``_note_gap``). ``since ==
+        0`` is a FRESH attach ("give me what's retained"), not an
+        established cursor — pre-history it never consumed is not loss —
+        so it never gaps. Returns None when nothing was missed."""
+        if since > 0 and oldest and since + 1 < oldest:
+            dropped = int(oldest - since - 1)
+            self._note_gap(dropped)
+            return {"gap": True, "dropped": dropped,
+                    "resume_seq": int(oldest)}
+        return None
+
+    # -- dict-rendering read surface (API/CLI compat) -------------------------
+    def tail(self, n: int = 100, **filters) -> List[Dict]:
+        """Last ``n`` records, newest last. ``filters`` narrow by exact
+        field match (verdict=, endpoint_id=, src_ip=, dst_port=, ...)."""
+        cols, _oldest, _newest = self.snapshot_columns()
+        total = cols["seq"].shape[0]
+        if not filters:
+            lo = max(0, total - n)
+            return [render_flow(cols, j) for j in range(lo, total)]
+        m, residual = _filter_mask(cols, filters)
+        idx = np.nonzero(m)[0]
+        if not residual:
+            idx = idx[-n:]               # render only what we return
+        items = []
+        for j in idx:
+            r = render_flow(cols, int(j))
+            if all(r.get(k) == v for k, v in residual.items()):
+                items.append(r)
+        return items[-n:]
+
+    def since(self, seq: int, limit: int = 1000, **filters) -> List[Dict]:
+        """Records with seq > ``seq``, oldest first (live-follow cursor; the
+        API's /v1/flows?since= and `monitor --api -f` poll this).
+
+        When the cursor predates the oldest retained record — the ring
+        wrapped past the follower — the result is PREFIXED with a
+        structured gap marker ``{"gap": True, "dropped": N,
+        "resume_seq": S}`` (and ``flowlog_follow_gaps_total`` /
+        ``flowlog_follow_gap_records_total`` count it), so loss is an
+        explicit record in the stream, never an inference left to seq
+        arithmetic."""
+        cols, oldest, _newest = self.snapshot_columns(since_seq=seq)
+        out: List[Dict] = []
+        gap = self.gap_marker(seq, oldest)
+        if gap is not None:
+            out.append(gap)
+        m, residual = _filter_mask(cols, filters)
+        n_rec = 0
+        for j in np.nonzero(m)[0]:
+            r = render_flow(cols, int(j))
+            if residual and not all(r.get(k) == v
+                                    for k, v in residual.items()):
+                continue
+            out.append(r)
+            n_rec += 1
+            if n_rec >= limit:
+                break
+        return out
 
     def flush_sink(self) -> int:
         """Append buffered records to the JSONL sink (called by the
@@ -140,33 +391,13 @@ class FlowLog:
             f.write("\n".join(lines) + "\n")
         return len(lines)
 
-    def tail(self, n: int = 100, **filters) -> List[Dict]:
-        """Last ``n`` records, newest last. ``filters`` narrow by exact
-        field match (verdict=, endpoint_id=, src_ip=, dst_port=, ...)."""
-        with self._lock:
-            if len(self._ring) < self.capacity:
-                items = self._ring[:]
-            else:
-                items = self._ring[self._next:] + self._ring[:self._next]
-        if filters:
-            items = [r for r in items
-                     if all(r.get(k) == v for k, v in filters.items())]
-        return items[-n:]
-
-    def since(self, seq: int, limit: int = 1000, **filters) -> List[Dict]:
-        """Records with seq > ``seq``, oldest first (live-follow cursor; the
-        API's /v1/flows?since= and `monitor --api -f` poll this)."""
-        with self._lock:
-            if len(self._ring) < self.capacity:
-                items = self._ring[:]
-            else:
-                items = self._ring[self._next:] + self._ring[:self._next]
-        out = [r for r in items if r.get("seq", 0) > seq
-               and all(r.get(k) == v for k, v in filters.items())]
-        return out[:limit]
-
     def to_jsonl(self, n: int = 100) -> str:
         return "\n".join(json.dumps(r) for r in self.tail(n))
 
+    @property
+    def newest_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
     def __len__(self) -> int:
-        return len(self._ring)
+        return self._count

@@ -37,7 +37,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from cilium_tpu.model.ipcache import lpm_lookup
+from cilium_tpu.compile.lpm import pack_pfx
+from cilium_tpu.model.ipcache import lpm_lookup, lpm_lookup_pfx
 from cilium_tpu.policy.repository import EndpointPolicy
 from cilium_tpu.utils import constants as C
 
@@ -88,6 +89,20 @@ class Verdict:
     rnat: bool = False
     rnat_src: bytes = b""           # VIP to restore as reply src
     rnat_sport: int = 0
+    # match provenance (ISSUE 11) — the numeric evidence columns the device
+    # emits, computed here from the SAME compiled snapshot geometry so the
+    # parity suite / shadow auditor can bit-compare them:
+    #   matched_rule: resolved policy-cell coordinate
+    #     (id_class * n_port_classes + port_class); -1 when no ladder ran
+    #     (unenforced direction, invalid row, NO_SERVICE) or when this
+    #     oracle was built without provenance tables (Oracle.for_snapshot
+    #     wires them; the bare constructor does not).
+    #   lpm_prefix: (prefix_slot << 8) | plen of the winning ipcache entry
+    #     (compile/lpm.pack_pfx); -1 on LPM miss / no provenance.
+    # ct_state_pre needs no field: it is ``ct_status`` (the probe class
+    # as-of classification), which the Verdict already carries.
+    matched_rule: int = -1
+    lpm_prefix: int = -1
 
 
 # --------------------------------------------------------------------------- #
@@ -436,28 +451,52 @@ def l7_match(http_rules, method: int, path: bytes) -> bool:
 # --------------------------------------------------------------------------- #
 # The oracle
 # --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class ProvenanceTables:
+    """The compiled-geometry slices the oracle needs to emit the SAME
+    numeric provenance the device does: identity-class / port-class maps
+    for matched_rule, and the prefix-slot enumeration for lpm_prefix.
+    Built by :meth:`Oracle.for_snapshot`; a bare-constructed oracle has
+    none and emits -1 (provenance unknown, never wrong)."""
+    class_of: object               # np [n_identities] identity idx → class
+    index_of: Dict[int, int]       # identity id → dense index
+    port_table: object             # np [families, 65536] → port class
+    n_port_classes: int
+    pfx_slot_of: Dict[str, int]    # canonical prefix → slot
+
+    @classmethod
+    def from_snapshot(cls, snap) -> "ProvenanceTables":
+        return cls(class_of=snap.id_classes.class_of,
+                   index_of=dict(snap.id_classes.index_of),
+                   port_table=snap.port_classes.table,
+                   n_port_classes=snap.port_classes.n_classes,
+                   pfx_slot_of=dict(snap.lpm.pfx_slot_of))
+
+
 class Oracle:
     @classmethod
     def for_snapshot(cls, snap, ct: Optional[ConntrackTable] = None
                      ) -> "Oracle":
         """Oracle over one compiled PolicySnapshot — the ONE place the
         snapshot→oracle construction (slot-aligned policies, compiled
-        ipcache, the n_frontends LB gate) lives, shared by the fake
-        datapath and the shadow auditor so their replays can never be
-        built against differently-wired oracles."""
+        ipcache, the n_frontends LB gate, the provenance tables) lives,
+        shared by the fake datapath and the shadow auditor so their
+        replays can never be built against differently-wired oracles."""
         return cls(dict(zip(snap.ep_ids, snap.policies)), snap.ipcache,
-                   ct=ct, lb=snap.lb if snap.lb.n_frontends else None)
+                   ct=ct, lb=snap.lb if snap.lb.n_frontends else None,
+                   prov=ProvenanceTables.from_snapshot(snap))
 
     def __init__(self, policies: Dict[int, EndpointPolicy],
                  ipcache_entries: Dict[str, int],
                  ct: Optional[ConntrackTable] = None,
-                 lb=None):
+                 lb=None, prov: Optional[ProvenanceTables] = None):
         self.policies = policies
         self.ipcache_entries = dict(ipcache_entries)
         self.ct = ct if ct is not None else ConntrackTable()
         # Service LB state: a compiled compile/lb.LBTables (control-plane
         # input, like the policy snapshot). None = no services.
         self.lb = lb
+        self.prov = prov
         self._frontends: Dict[Tuple[bytes, int, int], int] = {}
         if lb is not None:
             from cilium_tpu.utils.ip import parse_addr
@@ -512,9 +551,36 @@ class Oracle:
 
     # -- helpers ------------------------------------------------------------
     def _remote_identity(self, p: PacketRecord) -> int:
+        return self._remote_identity_pfx(p)[0]
+
+    def _remote_identity_pfx(self, p: PacketRecord) -> Tuple[int, int]:
+        """One LPM walk → (remote identity, packed lpm_prefix provenance).
+        The identity and the prefix come from the SAME winning entry — the
+        host mirror of the device trie's paired value/provenance planes."""
         from cilium_tpu.utils.ip import addr_to_str
         remote = p.dst_addr if p.direction == C.DIR_EGRESS else p.src_addr
-        return lpm_lookup(self.ipcache_entries, addr_to_str(remote))
+        ident, prefix, plen = lpm_lookup_pfx(self.ipcache_entries,
+                                             addr_to_str(remote))
+        if self.prov is None or prefix is None:
+            return ident, -1
+        slot = self.prov.pfx_slot_of.get(prefix, -1)
+        return ident, (pack_pfx(slot, plen) if slot >= 0 else -1)
+
+    def _rule_of(self, remote_id: int, proto: int, dport: int) -> int:
+        """matched_rule for one (remote, proto, dport): the resolved
+        verdict-cell coordinate id_class * n_port_classes + port_class —
+        the same gathers the device ladder runs (kernels/policy.py), over
+        the same compiled tables."""
+        prov = self.prov
+        if prov is None:
+            return -1
+        idx = prov.index_of.get(remote_id)
+        if idx is None:
+            return -1
+        id_cls = int(prov.class_of[idx])
+        fam = C.proto_family(proto)
+        pcls = int(prov.port_table[fam, min(max(dport, 0), 65535)])
+        return id_cls * prov.n_port_classes + pcls
 
     def _evaluate(self, p: PacketRecord, remote_id: int):
         """Current-policy evaluation → (enforced, lookup_result | None).
@@ -527,8 +593,24 @@ class Oracle:
             return (False, None)
         return (True, dirpol.lookup(remote_id, p.proto, p.dst_port))
 
-    def _verdict_for(self, p: PacketRecord, remote_id: int, status: int
-                     ) -> Tuple[Verdict, bool]:
+    def _verdict_for(self, p: PacketRecord, remote_id: int, status: int,
+                     pfx: int = -1) -> Tuple[Verdict, bool]:
+        """(verdict, create_entry) against the current CT probe result,
+        with the provenance columns attached: ``pfx`` is the packed
+        lpm_prefix from the caller's LPM walk; matched_rule follows the
+        device mask exactly — a value wherever the direction is enforced
+        (CT-hit rows included: the ladder is computed branch-free either
+        way), -1 otherwise."""
+        verdict, create = self._verdict_core(p, remote_id, status)
+        mr = -1
+        if self.prov is not None:
+            pol = self.policies.get(p.ep_id)
+            if pol is not None and pol.direction(p.direction).enforced:
+                mr = self._rule_of(remote_id, p.proto, p.dst_port)
+        return replace(verdict, matched_rule=mr, lpm_prefix=pfx), create
+
+    def _verdict_core(self, p: PacketRecord, remote_id: int, status: int
+                      ) -> Tuple[Verdict, bool]:
         """(verdict, create_entry) against the current CT probe result."""
         ev = self._evaluate(p, remote_id)
         if ev is None:
@@ -596,10 +678,11 @@ class Oracle:
         flags ct_full on any other row still mismatches."""
         tp, rev_nat, no_backend = self._translate(p)
         if no_backend:
+            rid, pfx = self._remote_identity_pfx(p)
             return Verdict(False, C.DropReason.NO_SERVICE, C.CTStatus.NEW,
-                           self._remote_identity(p)), False
-        remote_id = self._remote_identity(tp)
-        verdict, create = self._verdict_for(tp, remote_id, status)
+                           rid, lpm_prefix=pfx), False
+        remote_id, pfx = self._remote_identity_pfx(tp)
+        verdict, create = self._verdict_for(tp, remote_id, status, pfx=pfx)
         if ct_full and create and verdict.allow:
             verdict = replace(verdict, allow=False,
                               drop_reason=C.DropReason.CT_FULL,
@@ -616,11 +699,12 @@ class Oracle:
         if no_backend:
             # kernel mirror: the packet is masked out of the datapath, so
             # its CT status reads NEW; remote identity from the VIP itself
+            rid, pfx = self._remote_identity_pfx(p)
             return Verdict(False, C.DropReason.NO_SERVICE, C.CTStatus.NEW,
-                           self._remote_identity(p))
-        remote_id = self._remote_identity(tp)
+                           rid, lpm_prefix=pfx)
+        remote_id, pfx = self._remote_identity_pfx(tp)
         status, hit_key = self.ct.probe(tp, now)
-        verdict, create = self._verdict_for(tp, remote_id, status)
+        verdict, create = self._verdict_for(tp, remote_id, status, pfx=pfx)
         extra: Dict = {}
         if rev_nat:
             extra.update(svc=True, nat_dst=tp.dst_addr,
@@ -657,15 +741,17 @@ class Oracle:
             tps.append(tp)
             rev_nats.append(rev_nat)
             if no_backend:
+                rid, pfx = self._remote_identity_pfx(p)
                 verdicts.append(Verdict(False, C.DropReason.NO_SERVICE,
-                                        C.CTStatus.NEW,
-                                        self._remote_identity(p)))
+                                        C.CTStatus.NEW, rid,
+                                        lpm_prefix=pfx))
                 probes.append((C.CTStatus.NEW, None))
                 continue
-            remote_id = self._remote_identity(tp)
+            remote_id, pfx = self._remote_identity_pfx(tp)
             status, hit_key = self.ct.probe(tp, now)
             probes.append((status, hit_key))
-            verdict, _create = self._verdict_for(tp, remote_id, status)
+            verdict, _create = self._verdict_for(tp, remote_id, status,
+                                                 pfx=pfx)
             extra: Dict = {}
             if rev_nat:
                 extra.update(svc=True, nat_dst=tp.dst_addr,

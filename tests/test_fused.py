@@ -113,27 +113,43 @@ def _fuzz_addresses(rng, entries, n):
 
 
 def _lpm_parity(entries, probes, default_index=0):
+    from cilium_tpu.compile.lpm import lpm_lookup_host_prov
+    from cilium_tpu.kernels.lpm import lpm_lookup_prov_batch
     idents = sorted(set(entries.values()))
     identity_index = {i: n for n, i in enumerate(idents)}
     tables = build_lpm(entries, identity_index, default_index)
     want = np.asarray([lpm_lookup_host(tables, a, v6) for a, v6 in probes],
                       dtype=np.int32)
+    want_meta = np.asarray(
+        [lpm_lookup_host_prov(tables, a, v6)[1] for a, v6 in probes],
+        dtype=np.int32)
     addr = np.stack([np.frombuffer(a, dtype=">u4").astype(np.uint32)
                      for a, _ in probes])
     is_v6 = np.asarray([v6 for _, v6 in probes])
     v4n, v6n = jnp.asarray(tables.v4_nodes), jnp.asarray(tables.v6_nodes)
-    got_jnp = np.asarray(lpm_lookup_batch(
-        v4n, v6n, jnp.asarray(addr), jnp.asarray(is_v6), default_index))
-    got_fused = np.asarray(fk.lpm_lookup_fused(
+    got_jnp, got_jnp_meta = lpm_lookup_prov_batch(
+        v4n, v6n, jnp.asarray(addr), jnp.asarray(is_v6), default_index)
+    got_fused, got_fused_meta = fk.lpm_lookup_fused(
         v4n, v6n, jnp.asarray(addr), jnp.asarray(is_v6), default_index,
-        interpret=True))
-    np.testing.assert_array_equal(got_jnp, want, "jnp walk != host walk")
-    np.testing.assert_array_equal(got_fused, want, "fused walk != host walk")
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_jnp), want,
+                                  "jnp walk != host walk")
+    np.testing.assert_array_equal(np.asarray(got_fused), want,
+                                  "fused walk != host walk")
+    # match provenance ((slot<<8)|plen) rides the same walk: all three
+    # executors must name the same winning prefix
+    np.testing.assert_array_equal(np.asarray(got_jnp_meta), want_meta,
+                                  "jnp provenance != host provenance")
+    np.testing.assert_array_equal(np.asarray(got_fused_meta), want_meta,
+                                  "fused provenance != host provenance")
     if not is_v6.any():
-        got4 = np.asarray(fk.lpm_lookup_fused(
+        got4, got4_meta = fk.lpm_lookup_fused(
             v4n, v6n, jnp.asarray(addr), jnp.asarray(is_v6), default_index,
-            v4_only=True, interpret=True))
-        np.testing.assert_array_equal(got4, want, "fused v4_only != host")
+            v4_only=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got4), want,
+                                      "fused v4_only != host")
+        np.testing.assert_array_equal(np.asarray(got4_meta), want_meta,
+                                      "fused v4_only provenance != host")
 
 
 class TestLPMFuzzParity:
@@ -282,7 +298,8 @@ class TestPolicyVerdictFused:
                     b["http_path"], est, reply, b["valid"])
             want = classify_interior_core(*args)
             got = fk.policy_verdict_fused(*args, interpret=True)
-            for name, w, g in zip(("allow", "reason", "status", "redirect"),
+            for name, w, g in zip(("allow", "reason", "status", "redirect",
+                                   "matched_rule"),
                                   want, got):
                 np.testing.assert_array_equal(np.asarray(w), np.asarray(g),
                                               (trial, name))

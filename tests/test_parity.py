@@ -222,8 +222,10 @@ def run_parity(seed, n_batches=6, batch=96, cap=4096, time_step=40,
     tensors = {k: jnp.asarray(v) for k, v in snap.tensors().items()}
     ct_dev = {k: jnp.asarray(v) for k, v in
               make_ct_arrays(CTConfig(capacity=cap)).items()}
-    oracle = Oracle(dict(zip(snap.ep_ids, snap.policies)),
-                    ctx.ipcache.snapshot())
+    # for_snapshot wires the provenance tables — the matched_rule /
+    # lpm_prefix / ct_state_pre columns below are part of the parity
+    # contract (ISSUE 11), pinned against the oracle like the verdicts
+    oracle = Oracle.for_snapshot(snap)
     prior = []
     now = 1000
     for bi in range(n_batches):
@@ -238,6 +240,9 @@ def run_parity(seed, n_batches=6, batch=96, cap=4096, time_step=40,
         got_reason = np.asarray(out["reason"])
         got_status = np.asarray(out["status"])
         got_rid = np.asarray(out["remote_identity"])
+        got_rule = np.asarray(out["matched_rule"])
+        got_pfx = np.asarray(out["lpm_prefix"])
+        got_pre = np.asarray(out["ct_state_pre"])
         for i, (p, v) in enumerate(zip(packets, want)):
             assert bool(got_allow[i]) == v.allow, \
                 f"seed={seed} batch={bi} pkt={i}: allow {bool(got_allow[i])} != {v.allow} ({p})"
@@ -247,6 +252,15 @@ def run_parity(seed, n_batches=6, batch=96, cap=4096, time_step=40,
                 f"seed={seed} batch={bi} pkt={i}: status {int(got_status[i])} != {int(v.ct_status)} ({p})"
             assert int(got_rid[i]) == v.remote_identity, \
                 f"seed={seed} batch={bi} pkt={i}: rid {int(got_rid[i])} != {v.remote_identity}"
+            assert int(got_rule[i]) == v.matched_rule, \
+                f"seed={seed} batch={bi} pkt={i}: matched_rule " \
+                f"{int(got_rule[i])} != {v.matched_rule} ({p})"
+            assert int(got_pfx[i]) == v.lpm_prefix, \
+                f"seed={seed} batch={bi} pkt={i}: lpm_prefix " \
+                f"{int(got_pfx[i])} != {v.lpm_prefix} ({p})"
+            assert int(got_pre[i]) == int(v.ct_status), \
+                f"seed={seed} batch={bi} pkt={i}: ct_state_pre " \
+                f"{int(got_pre[i])} != {int(v.ct_status)} ({p})"
         dev_ct = extract_device_ct(ct_dev, now)
         ora_ct = oracle_live_ct(oracle, now)
         assert dev_ct == ora_ct, (

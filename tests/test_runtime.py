@@ -441,10 +441,14 @@ class TestFlowLogFollowEdges:
         # oldest-first ordering holds across the physical wrap point
         got = log.since(0)
         assert [r["seq"] for r in got] == list(range(13, 21))
-        # a cursor that fell off the ring resumes at the oldest retained
-        # record (records 1..12 are gone — the follower can detect the gap
-        # from the seq jump)
-        assert log.since(5)[0]["seq"] == 13
+        # a cursor that fell off the ring gets an EXPLICIT structured gap
+        # marker (records 6..12 are gone), then resumes at the oldest
+        # retained record — loss is a record in the stream, not an
+        # inference left to seq arithmetic
+        got = log.since(5)
+        assert got[0] == {"gap": True, "dropped": 7, "resume_seq": 13}
+        assert got[1]["seq"] == 13
+        assert log.follow_gaps == 1 and log.follow_gap_records == 7
         # cursor at the head: nothing new
         assert log.since(20) == []
         # limit caps oldest-first (the poll page)
