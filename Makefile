@@ -10,7 +10,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        ddos-smoke shim bench clean
+        ddos-smoke cluster-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -108,7 +108,28 @@ ddos-smoke:
 	$(PYTEST_ENV) python bench.py --ddos > /tmp/cilium_tpu_ddos_gate.json
 	$(PYTEST_ENV) python bench.py --ddos --compare /tmp/cilium_tpu_ddos_gate.json > /dev/null
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke
+# Multi-host serving gate (ISSUE 12: runtime/clustermesh.py +
+# runtime/cluster.py): the tier-1 clustermesh subset — the partition
+# contract (last-good serving, MESH_STALE past the staleness budget,
+# lease expiry only under a healthy listing, dead-peer tombstones),
+# deterministic conflict resolution pinned on BOTH ingest orders, store
+# hygiene (spoofed peer files, tmp-litter sweep, loud withdraw), the
+# prefix hand-off racing lease expiry, replication-lag clamping — plus
+# the slow-marked 2-proc partition/heal soak (real spawned engine
+# processes over one store, `clustermesh.peer_read` +
+# `clustermesh.store_list` faults armed through six partition rounds,
+# gating on convergence-after-heal and zero parity mismatches at
+# sampling 1.0), and a `bench.py --cluster 3` round whose artifact gate
+# (convergence via the delta-patch path, cross-boundary verdict
+# spot-audit, partition / peer-kill+restart / conflicting-claims /
+# skewed-clock chaos, relay fan-in spanning every node, zero audit
+# mismatches) exits 4 on failure.
+cluster-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_clustermesh.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_clustermesh.py -q -m slow
+	$(PYTEST_ENV) env CILIUM_TPU_CLUSTER_DATAPATH=fake python bench.py --cluster 3 --preset smoke > /tmp/cilium_tpu_cluster_gate.json
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

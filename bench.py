@@ -1231,6 +1231,331 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
     }
 
 
+def cluster_bench(n_nodes: int, preset: str, verbose: bool = False):
+    """cfg7: multi-host serving over the clustermesh store (ISSUE 12 /
+    ROADMAP item 3 — the horizontal-scale counterpart to cfg6's
+    single-host overload ladder). N engine PROCESSES (runtime/cluster.py,
+    spawn — real per-host isolation: own jax, own FAULTS, own identity
+    numbering) share one store directory; each publishes its endpoints'
+    (prefix, labels) and ingests its peers', so ordinary label policy
+    selects remote pods.
+
+    Phases: (1) converge — every node's remote view matches the union of
+    its peers' ledgers, with the post-seed ingest riding the PR 9
+    delta-patch path (``regen_incremental_total`` must move); (2) serve —
+    cross-boundary traffic on every node, aggregate fps + per-node
+    replication-lag p99, with the parity auditor armed at sampling 1.0
+    (the oracle replay IS "the merged world" check); (3) chaos — store
+    partition on one node (``clustermesh.store_list``: last-good serving,
+    MESH_STALE past the budget, heal), peer kill + lease-expiry withdrawal
+    + restart + re-convergence, conflicting prefix claims resolved
+    identically on every observer (n >= 3), and a skewed publisher clock
+    (entries survive, lag clamps at zero); (4) relay fan-in — every node's
+    flowlog JSONL tailed into one FlowRelay, every node visible in the
+    merged stream. ``cluster_gate`` fails the artifact (exit 4) on any
+    violation: non-convergence, parity mismatches, fail-closed remote
+    flows during partition, MESH_STALE missing/sticky, observer
+    disagreement on a conflicting claim, a node missing from the relay."""
+    import shutil
+    import tempfile
+
+    from cilium_tpu.observe.relay import FlowRelay, JsonlTailObserver
+    from cilium_tpu.runtime.cluster import ClusterSupervisor
+
+    smoke = preset == "smoke"
+    datapath = os.environ.get("CILIUM_TPU_CLUSTER_DATAPATH", "jit")
+    serve_batches = 20 if smoke else 80
+    stale_after_s = 2.0
+    staleness_budget_s = 1.0
+    gate_reasons = []
+    phases = {}
+
+    def note(phase, **kw):
+        phases[phase] = kw
+        if verbose:
+            print(f"# cluster phase {phase}: {kw}", file=sys.stderr)
+
+    def gate(ok, reason):
+        if not ok:
+            gate_reasons.append(reason)
+        return ok
+
+    names = [f"node-{i}" for i in range(n_nodes)]
+    work = tempfile.mkdtemp(prefix="cilium-tpu-cluster-")
+    store = os.path.join(work, "store")
+    flows_dir = os.path.join(work, "flows")
+    os.makedirs(flows_dir)
+    overrides = {
+        name: {"cluster_stale_after_s": stale_after_s,
+               "cluster_staleness_budget_s": staleness_budget_s,
+               "flowlog_path": os.path.join(flows_dir, f"{name}.jsonl")}
+        for name in names}
+
+    def node_ip(i):
+        return f"10.{i + 1}.0.10"
+
+    def setup_node(sup, i):
+        name = names[i]
+        sup.add_endpoint(name, ["k8s:cluster=mesh", f"k8s:app=svc{i}"],
+                         [node_ip(i)], ep_id=1)
+        sup.nodes[name].call("policy", docs=[{
+            "endpointSelector": {"matchLabels": {"app": f"svc{i}"}},
+            "ingress": [{"fromEndpoints": [
+                {"matchLabels": {"cluster": "mesh"}}],
+                "toPorts": [{"ports": [
+                    {"port": "8080", "protocol": "TCP"}]}]}]}])
+        sup.nodes[name].call("regen")   # seed the incremental compiler
+                                        # BEFORE remote entries arrive
+
+    def cross_flows(i, sport0=41000):
+        """Flows node i serves: one allowed cross-boundary flow per peer
+        (remote pod ip → local pod, the mesh-selected port) + junk drops
+        (unknown world sources)."""
+        flows = []
+        for j in range(n_nodes):
+            if j == i:
+                continue
+            flows.append({"src": node_ip(j), "dst": node_ip(i),
+                          "sport": sport0 + j, "dport": 8080, "ep_id": 1})
+        flows.append({"src": "203.0.113.9", "dst": node_ip(i),
+                      "sport": sport0 + 99, "dport": 8080, "ep_id": 1})
+        flows.append({"src": node_ip(i - 1 if i else n_nodes - 1),
+                      "dst": node_ip(i), "sport": sport0 + 98,
+                      "dport": 23, "ep_id": 1})   # wrong port → drop
+        return flows
+
+    def expect_cross(out, i):
+        """allowed cross flows per peer, junk + wrong-port denied."""
+        want = [True] * (n_nodes - 1) + [False, False]
+        return list(out["allow"]) == want
+
+    sup = ClusterSupervisor(store, names, overrides=overrides,
+                            datapath=datapath)
+    t_bench0 = time.monotonic()
+    try:
+        # -- phase 1: boot + converge (delta-patch ingest) ------------------
+        for i in range(n_nodes):
+            setup_node(sup, i)
+        rounds = sup.converge(max_rounds=3 + n_nodes)
+        statuses = sup.broadcast("status")
+        delta_used = {n: statuses[n]["counters"].get(
+            "regen_incremental_total", 0) for n in names}
+        gate(all(v >= 1 for v in delta_used.values()),
+             f"remote ingest did not ride the delta-patch path on every "
+             f"node (regen_incremental_total={delta_used})")
+        note("converge", rounds=rounds, delta_used=delta_used)
+
+        # -- phase 2: serve + cross-boundary verdict spot-audit -------------
+        per_node = {}
+        for i, name in enumerate(names):
+            res = sup.nodes[name].call(
+                "serve", flows=cross_flows(i), batches=serve_batches,
+                now=5000, timeout=600.0)
+            per_node[name] = res
+        agg_fps = sum(r["fps"] for r in per_node.values())
+        spot_ok = {}
+        for i, name in enumerate(names):
+            out = sup.nodes[name].call("classify",
+                                       flows=cross_flows(i, sport0=45000),
+                                       now=6000)
+            spot_ok[name] = expect_cross(out, i)
+        gate(all(spot_ok.values()),
+             f"cross-boundary verdict spot-audit failed: {spot_ok}")
+        # flush every node's flowlog sink NOW: the kill phase below takes a
+        # node down hard, and the relay must still see its served flows
+        sup.broadcast("flush")
+        note("serve", aggregate_fps=round(agg_fps, 1),
+             per_node_fps={n: round(r["fps"], 1)
+                           for n, r in per_node.items()})
+
+        # -- phase 3a: store partition on node-0 ----------------------------
+        victim = names[0]
+        sup.nodes[victim].call("arm", point="clustermesh.store_list",
+                               spec={"mode": "fail"})
+        during = []
+        for _ in range(3):
+            sup.broadcast("step")
+            out = sup.nodes[victim].call("classify",
+                                         flows=cross_flows(0, 46000),
+                                         now=7000)
+            during.append(expect_cross(out, 0))
+            time.sleep(0.45)
+        gate(all(during),
+             "partitioned node failed closed on established remote flows")
+        st = sup.nodes[victim].call("status")
+        gate(st["mesh"]["state"] == "MESH_STALE",
+             f"partitioned node never reported MESH_STALE past the "
+             f"{staleness_budget_s}s budget (state={st['mesh']['state']})")
+        gate(st["health"]["state"] == "DEGRADED",
+             f"health did not degrade on MESH_STALE "
+             f"(state={st['health']['state']})")
+        sup.nodes[victim].call("disarm", point="clustermesh.store_list")
+        sup.broadcast("step")
+        st = sup.nodes[victim].call("status")
+        gate(st["mesh"]["state"] == "OK",
+             f"MESH_STALE did not clear after heal "
+             f"(state={st['mesh']['state']})")
+        rounds_heal = sup.converge(max_rounds=4)
+        note("partition", during_partition_served=all(during),
+             healed_rounds=rounds_heal)
+
+        # -- phase 3b: peer kill → lease expiry → restart → re-converge -----
+        dead = names[-1]
+        dead_idx = n_nodes - 1
+        sup.nodes[dead].kill()
+        survivors = names[:-1]
+        dead_prefix = f"{node_ip(dead_idx)}/32"
+        # detection latency is [stale_after, 2*stale_after): a survivor
+        # that cached generation G-1 observes the dead node's final G on
+        # its first post-kill sync as "progress" and renews the lease once
+        # — withdrawal lands within one more lease window
+        withdrawn = False
+        expiry_deadline = time.monotonic() + 2 * stale_after_s + 2.0
+        while not withdrawn and time.monotonic() < expiry_deadline:
+            time.sleep(stale_after_s * 0.6)
+            sup.broadcast("step", only=survivors)
+            views = sup.views(only=survivors)
+            withdrawn = all(dead_prefix not in views[n] for n in survivors)
+        gate(withdrawn,
+             f"dead peer's prefix {dead_prefix} not withdrawn after lease "
+             f"expiry")
+        # the withdrawn identity fails closed for NEW flows (stale IP must
+        # not keep the old pod's permissions)
+        out = sup.nodes[names[0]].call("classify", flows=[
+            {"src": node_ip(dead_idx), "dst": node_ip(0),
+             "sport": 47001, "dport": 8080, "ep_id": 1}], now=8000)
+        gate(not out["allow"][0],
+             "withdrawn remote identity still allowed after lease expiry")
+        sup.restart(dead)
+        setup_node(sup, dead_idx)
+        rounds_back = sup.converge(max_rounds=4 + n_nodes)
+        out = sup.nodes[names[0]].call("classify", flows=[
+            {"src": node_ip(dead_idx), "dst": node_ip(0),
+             "sport": 47002, "dport": 8080, "ep_id": 1}], now=8100)
+        gate(bool(out["allow"][0]),
+             "restarted peer's pod not re-admitted after re-convergence")
+        # the restarted node serves again (feeds its auditor + flowlog —
+        # the relay below must span the RESTARTED mesh, not just the
+        # pre-kill one)
+        sup.nodes[dead].call("serve", flows=cross_flows(dead_idx, 48000),
+                             batches=max(5, serve_batches // 4), now=8200,
+                             timeout=600.0)
+        sup.nodes[dead].call("flush")
+        note("kill_restart", withdrawn=withdrawn,
+             reconverged_rounds=rounds_back)
+
+        # -- phase 3c: conflicting claims (needs a third observer) ----------
+        if n_nodes >= 3:
+            cprefix = "10.77.0.7/32"
+            sup.add_endpoint(names[0], ["k8s:app=moving"], ["10.77.0.7"],
+                             ep_id=7)
+            sup.add_endpoint(names[1], ["k8s:app=moving"], ["10.77.0.7"],
+                             ep_id=7)
+            for _ in range(2):
+                sup.broadcast("step")
+            observers = names[2:]
+            winners = {}
+            for name in observers:
+                st = sup.nodes[name].call("status")
+                conf = st["mesh"]["conflicts"].get(cprefix)
+                winners[name] = conf["winner"] if conf else None
+                gate(any(k.startswith("clustermesh_conflicts_total")
+                         for k in st["counters"]),
+                     f"{name}: conflicting claim not counted")
+            gate(len(set(winners.values())) == 1
+                 and None not in winners.values(),
+                 f"observers disagree on the conflict winner: {winners}")
+            # every observer ingested the prefix under exactly one claim
+            views = sup.views(only=observers)
+            gate(all(cprefix in views[n] for n in observers),
+                 f"conflicted prefix not served by observers: "
+                 f"{ {n: cprefix in views[n] for n in observers} }")
+            sup.remove_endpoint(names[0], 7, ips=["10.77.0.7"])
+            sup.remove_endpoint(names[1], 7, ips=["10.77.0.7"])
+            rounds_conf = sup.converge(max_rounds=4)
+            note("conflict", winners=winners, resolved_rounds=rounds_conf)
+        else:
+            note("conflict", skipped=f"needs >= 3 nodes, ran {n_nodes}")
+
+        # -- phase 3d: skewed publisher clock -------------------------------
+        skewed = names[1]
+        sup.nodes[skewed].call("skew", seconds=3600.0)
+        for _ in range(2):
+            sup.broadcast("step")
+        views = sup.views()
+        skew_prefix = f"{node_ip(1)}/32"
+        holders = [n for n in names if n != skewed]
+        skew_ok = all(skew_prefix in views[n] for n in holders)
+        gate(skew_ok, f"peers dropped a live publisher whose clock is "
+                      f"3600s ahead (views={ {n: skew_prefix in views[n] for n in holders} })")
+        lags = {n: sup.nodes[n].call("status")["mesh"]
+                ["replication_lag_p99_s"] for n in holders}
+        gate(all(v >= 0 for v in lags.values()),
+             f"replication lag went negative under clock skew: {lags}")
+        sup.nodes[skewed].call("skew", seconds=0.0)
+        note("skewed_clock", entries_survive=skew_ok, lag_p99=lags)
+
+        # -- phase 4: relay fan-in over the nodes' flowlog sinks ------------
+        sup.broadcast("flush")
+        relay = FlowRelay({name: JsonlTailObserver(
+            os.path.join(flows_dir, f"{name}.jsonl")) for name in names})
+        merged = relay.poll(limit=100_000)
+        seen_nodes = {r.get("node") for r in merged["flows"]
+                      if not r.get("gap")}
+        gate(seen_nodes == set(names),
+             f"relay fan-in missing nodes: saw {sorted(seen_nodes)} of "
+             f"{names}")
+        note("relay", merged_flows=len(merged["flows"]),
+             nodes=sorted(seen_nodes),
+             lag=merged["lag"], gaps=len(merged["gaps"]))
+
+        # -- phase 5: final parity audit + lag p99 --------------------------
+        audits = sup.broadcast("audit")
+        mismatched = {n: a["mismatched_rows"] for n, a in audits.items()}
+        checked = {n: a["checked_rows"] for n, a in audits.items()}
+        gate(all(v == 0 for v in mismatched.values()),
+             f"parity mismatches at sampling 1.0: {mismatched}")
+        gate(all(v > 0 for v in checked.values()),
+             f"auditor checked nothing on some node: {checked}")
+        statuses = sup.broadcast("status")
+        lag_p99 = {n: statuses[n]["mesh"]["replication_lag_p99_s"]
+                   for n in names}
+        note("audit", checked=checked, mismatched=mismatched)
+    finally:
+        try:
+            sup.stop_all()
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+    elapsed = time.monotonic() - t_bench0
+
+    if verbose:
+        print(f"# cluster n={n_nodes} preset={preset} agg_fps={agg_fps:.0f}"
+              f" lag_p99={max(lag_p99.values()):.4f}s gate_reasons="
+              f"{gate_reasons}", file=sys.stderr)
+
+    return {
+        "metric": f"cluster_mesh_serving_n{n_nodes}_cfg7",
+        "value": round(agg_fps, 1),
+        "unit": "aggregate_flows/sec",
+        "vs_baseline": round(agg_fps / (PER_CHIP_TARGET * n_nodes), 6),
+        "nodes": n_nodes,
+        "preset": preset,
+        "datapath": datapath,
+        "elapsed_s": round(elapsed, 1),
+        "aggregate_fps": round(agg_fps, 1),
+        "per_node_fps": {n: round(r["fps"], 1)
+                         for n, r in per_node.items()},
+        "replication_lag_p99_s": lag_p99,
+        "replication_lag_p99_max_s": max(lag_p99.values()),
+        "audit": {"checked_rows": checked, "mismatched_rows": mismatched},
+        "phases": phases,
+        "cluster_gate": {
+            "failed": bool(gate_reasons),
+            **({"reasons": gate_reasons} if gate_reasons else {}),
+        },
+    }
+
+
 BUILDERS = {1: build_config1, 2: build_config2, 3: build_config3,
             4: build_config4, 5: build_config5}
 METRIC_NAMES = {
@@ -2448,6 +2773,15 @@ def main(argv=None):
                          "p99, CT occupancy trajectory, overload-ladder "
                          "dwell times; auditor at sampling 1.0; gate "
                          "failures exit 4")
+    ap.add_argument("--cluster", type=int, default=0, metavar="N",
+                    help="cfg7 multi-host serving: N engine PROCESSES over "
+                         "one clustermesh store (runtime/cluster.py) — "
+                         "converge (delta-patch ingest), cross-boundary "
+                         "serve with the auditor at 1.0, chaos (store "
+                         "partition / peer kill+restart / conflicting "
+                         "claims / skewed clock), relay fan-in over the "
+                         "nodes' flowlogs; reports aggregate fps + "
+                         "replication-lag p99; gate failures exit 4")
     ap.add_argument("--kernels", action="store_true",
                     help="per-kernel compute-only microbench of the "
                          "classify interior (lpm / ct_probe / policy_l7 / "
@@ -2538,6 +2872,23 @@ def main(argv=None):
             sys.exit(rc)
 
     _start_watchdog(METRIC_NAMES[args.config])
+    if args.cluster:
+        if args.cluster < 2:
+            ap.error("--cluster needs N >= 2")
+        result = cluster_bench(args.cluster, preset, verbose=args.verbose)
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("cluster_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
     if args.kernels:
         result = kernels_bench(args.config, preset, batch, batches,
                                verbose=args.verbose, fused_mode=args.fused)

@@ -279,6 +279,21 @@ def register(sub: "argparse._SubParsersAction") -> None:
                     default=None, help="default: both")
     mg.set_defaults(func=_cmd_map_get)
 
+    p = sub.add_parser(
+        "mesh", help="clustermesh inspection (cilium clustermesh status): "
+                     "per-peer generation/lag, store reachability, "
+                     "staleness verdict, conflicting prefix claims, "
+                     "replication-lag p99 (runtime/clustermesh.py)")
+    hsub = p.add_subparsers(dest="subcmd", required=True)
+    hs = hsub.add_parser(
+        "status", help="the mesh health/lag surface of a live agent "
+                       "(the 'mesh' key of /v1/status)")
+    hs.add_argument("--api", metavar="SOCKET", required=True,
+                    help="the running engine's REST socket")
+    hs.add_argument("-o", "--output", choices=["text", "json"],
+                    default="text")
+    hs.set_defaults(func=_cmd_mesh_status)
+
 
 def _add_state_dir(p):
     p.add_argument("--state-dir",
@@ -921,6 +936,40 @@ def _cmd_trace(args) -> int:
                   f"{sp['duration_ms']:.3f}ms"
                   + (f" {attrs}" if attrs else ""))
     return 0
+
+
+def _cmd_mesh_status(args) -> int:
+    """Exit 0 on a healthy mesh, 1 when no mesh is attached, 2 when the
+    mesh is MESH_STALE (scriptable: a monitoring probe can alert on it)."""
+    doc = _live(args, "GET", "/v1/status")
+    mesh = doc.get("mesh")
+    if mesh is None:
+        print("clustermesh is not attached (set cluster_store + "
+              "node_name)", file=sys.stderr)
+        return 1
+    rc = 2 if mesh.get("state") == C.MESH_STALE else 0
+    if args.output == "json":
+        print(json.dumps(mesh, indent=2, default=str))
+        return rc
+    print(f"node={mesh['node']} generation={mesh['generation']} "
+          f"state={mesh['state']} store_ok={mesh['store_ok']} "
+          f"last_good_pass_age={mesh['last_good_pass_age_s']}s "
+          f"budget={mesh['staleness_budget_s']}s")
+    print(f"remote_entries={mesh['remote_entries']} "
+          f"replication_lag_p99={mesh['replication_lag_p99_s']}s")
+    peers = mesh.get("peers", {})
+    if peers:
+        print(f"{'peer':<24} {'generation':>10} {'entries':>8} "
+              f"{'lag s':>9}")
+        for name, pe in sorted(peers.items()):
+            print(f"{name:<24} {pe['generation']:>10} "
+                  f"{pe['entries']:>8} {pe['lag_s']:>9.3f}")
+    else:
+        print("no live peers")
+    for prefix, conf in sorted(mesh.get("conflicts", {}).items()):
+        print(f"conflict {prefix}: winner={conf['winner']} "
+              f"losers={','.join(conf['losers'])}")
+    return rc
 
 
 def _cmd_debug_bundle(args) -> int:

@@ -101,6 +101,8 @@ class UpdateStats:
     id_class_splits: int = 0
     port_class_splits: int = 0
     delta_rows: int = 0                # rows shipped as a sparse delta
+    new_identities: int = 0            # appended identity classes (ISSUE 12)
+    lpm_rebuilt: bool = False          # ipcache delta → new trie tensors
     fallback: Optional[str] = None     # reason a full rebuild was required
 
 
@@ -181,6 +183,11 @@ class IncrementalCompiler:
     #: copies stay O(budget) and the amortized cost of a long churn run is
     #: O(1) copies per update
     REBASE_ROWS = 4096
+    #: identity-growth budget: a cycle absorbing more NEW identities than
+    #: this (each appends a verdict row per plane and re-expands matching
+    #: rules) falls back to a full rebuild — a mass remote-cluster join is
+    #: cheaper as one compile than thousands of appends
+    IDENT_GROWTH_MAX = 512
 
     def __init__(self, repo: Repository, ctx: PolicyContext,
                  endpoints: Sequence[Endpoint], snap: PolicySnapshot,
@@ -310,12 +317,29 @@ class IncrementalCompiler:
         runs build_snapshot and re-seeds). ``endpoints`` is the CALLER'S
         current endpoint set — the gate compares it against the seeded set
         (passing nothing skips that gate; only safe when the caller knows
-        the set is unchanged)."""
+        the set is unchanged).
+
+        ISSUE 12: pure identity GROWTH (new identities allocated, none
+        removed — the clustermesh remote-influx / CIDR-rule / FQDN-learn
+        shape) and ipcache changes no longer gate to a full rebuild: new
+        identities append singleton classes (verdict rows recomputed,
+        matching resident rules re-contribute their keys), and an ipcache
+        delta rebuilds just the LPM trie tensors into the patch."""
         stats = UpdateStats()
         gate = self._gate(endpoints)
         if gate is not None:
             self.last_fallback = gate
             return None
+        gate, new_idents = self._identity_delta()
+        if gate is not None:
+            self.last_fallback = gate
+            return None
+        # read the revision BEFORE the snapshot: a concurrent upsert
+        # between the two leaves the recorded revision behind the content,
+        # which only means one redundant rebuild next cycle — never a
+        # missed one
+        ipcache_rev = self.ctx.ipcache.revision
+        ipcache_dirty = ipcache_rev != self.base.ipcache_revision
         rev_now = self.repo.revision
         changes = self.repo.changes_since(self.base.revision)
         if changes is None:
@@ -343,10 +367,18 @@ class IncrementalCompiler:
 
         self._cycle_reset()
         dirty: Set[Tuple[int, int, MapStateKey]] = set()
+        patch = SnapshotPatch(base_revision=self.base.revision)
         enforce_before = {slot: (self._enforced_value(slot, 0),
                                  self._enforced_value(slot, 1))
                           for slot in range(len(self.endpoints))}
 
+        # identity growth FIRST: the changelog's re-expansions below must
+        # find the new identities already indexed, and growth itself only
+        # touches rules that predate this cycle's changes
+        forced_rows: Set[Tuple[int, int, int]] = set()
+        if new_idents:
+            forced_rows = self._grow_identities(new_idents, patch, dirty,
+                                                stats)
         for ch in changes:
             self._apply_change(ch, dirty)
 
@@ -371,10 +403,9 @@ class IncrementalCompiler:
                     dirty.add((slot, d, _LOCALHOST_KEY))
 
         stats.keys_touched = len(dirty)
-        patch = SnapshotPatch(base_revision=self.base.revision)
 
         # --- re-merge dirty keys into mapstates; collect affected rows ---
-        affected_rows: Set[Tuple[int, int, int]] = set()
+        affected_rows: Set[Tuple[int, int, int]] = set(forced_rows)
         whole_planes: Set[Tuple[int, int]] = set(flipped_planes)
         l7_dirty = False
         for slot, d, key in sorted(
@@ -441,21 +472,38 @@ class IncrementalCompiler:
             patch.full_tensors.update(
                 ("l7_methods", "l7_path", "l7_path_len", "l7_valid"))
 
-        snap = self._emit(rev_now, ct_config, l7_dirty)
+        # --- ipcache delta: rebuild just the LPM trie tensors ------------
+        # (the remote-prefix / CIDR / FQDN-learn surface — O(prefixes),
+        # no policy re-resolution; the patch re-ships the two node arrays)
+        new_lpm = new_ipcache = None
+        if ipcache_dirty:
+            from cilium_tpu.compile.lpm import build_lpm
+            new_ipcache = self.ctx.ipcache.snapshot()
+            new_lpm = build_lpm(
+                new_ipcache, self.index_of,
+                default_index=self.index_of[C.IDENTITY_WORLD])
+            patch.full_tensors.update(("lpm_v4", "lpm_v6"))
+            stats.lpm_rebuilt = True
+
+        snap = self._emit(rev_now, ct_config, l7_dirty, lpm=new_lpm,
+                          ipcache=new_ipcache,
+                          ipcache_revision=ipcache_rev if ipcache_dirty
+                          else None)
         self.base = snap
+        if new_idents:
+            self.identity_sig = tuple(
+                i.id for i in self.ctx.allocator.all())
         return snap, patch, stats
 
     # ------------------------------------------------------------------ #
     # gates
     # ------------------------------------------------------------------ #
     def _gate(self, endpoints: Optional[Sequence[Endpoint]]) -> Optional[str]:
+        """Hard geometry gates. Identity growth and ipcache changes are no
+        longer here — ``try_update`` absorbs them (ISSUE 12)."""
         if endpoints is not None \
                 and _endpoint_sig(endpoints) != self.ep_sig:
             return "endpoint-set-changed"
-        if tuple(i.id for i in self.ctx.allocator.all()) != self.identity_sig:
-            return "identity-set-changed"
-        if self.ctx.ipcache.revision != self.base.ipcache_revision:
-            return "ipcache-changed"
         if self.ctx.services.revision != self.base.services_revision:
             return "services-changed"
         if self.ctx.enforcement_mode != self.base.enforcement_mode:
@@ -463,6 +511,24 @@ class IncrementalCompiler:
         if self.ctx.allow_localhost != self.base.allow_localhost:
             return "allow-localhost-changed"
         return None
+
+    def _identity_delta(self) -> Tuple[Optional[str], List]:
+        """→ (fallback reason, new identities). Pure growth is absorbable
+        (appended singleton classes); a removed identity would shrink the
+        class axis — a geometry rewrite the full compiler owns. Removal +
+        re-add of the same id cannot be confused with stability: allocator
+        ids are never reused (monotonic counters)."""
+        idents = self.ctx.allocator.all()
+        cur = tuple(i.id for i in idents)
+        if cur == self.identity_sig:
+            return None, []
+        old = set(self.identity_sig)
+        if old - set(cur):
+            return "identity-removed", []
+        new = [i for i in idents if i.id not in old]
+        if len(new) > self.IDENT_GROWTH_MAX:
+            return "identity-growth-budget", []
+        return None, new
 
     # ------------------------------------------------------------------ #
     # change application
@@ -535,6 +601,68 @@ class IncrementalCompiler:
     # ------------------------------------------------------------------ #
     # geometry growth
     # ------------------------------------------------------------------ #
+    def _grow_identities(self, new_idents, patch: SnapshotPatch, dirty,
+                         stats: UpdateStats) -> Set[Tuple[int, int, int]]:
+        """Append one singleton class per NEW identity (ISSUE 12: remote
+        label sets → local identities → compiled rows, without a full
+        rebuild). The verdict image grows one row per plane per identity,
+        resident rules whose selectors now resolve the identities
+        re-contribute keys for them (the selector cache updated live on
+        allocation; :meth:`Repository.rules_selecting_identities` is the
+        cheap prefilter), and every appended row is recomputed by the
+        caller — returns the forced (slot, dir, class) row set. Geometry
+        growth ⇒ full verdict re-upload, same as a class split."""
+        k = len(new_idents)
+        v = self._materialize_verdict()
+        self._base_verdict = np.concatenate(
+            [v, np.zeros(v.shape[:2] + (k, v.shape[3]), dtype=v.dtype)],
+            axis=2)
+        # index_of is SHARED with previously-emitted snapshots: copy before
+        # the first mutation, or an old snapshot would resolve a new
+        # identity id into a class row it does not have
+        self.index_of = dict(self.index_of)
+        ids = np.asarray([i.id for i in new_idents],
+                         dtype=self.identity_ids.dtype)
+        base_idx = len(self.identity_ids)
+        self.identity_ids = np.concatenate([self.identity_ids, ids])
+        self._class_of = np.concatenate(
+            [self._class_of,
+             np.arange(self._n_classes, self._n_classes + k,
+                       dtype=self._class_of.dtype)])
+        forced: Set[Tuple[int, int, int]] = set()
+        for j, ident in enumerate(new_idents):
+            self.index_of[int(ident.id)] = base_idx + j
+            cls = self._n_classes
+            self._n_classes += 1
+            self._members[cls] = {int(ident.id)}
+            self._representative.append(int(ident.id))
+            for slot in range(len(self.endpoints)):
+                forced.add((slot, C.DIR_EGRESS, cls))
+                forced.add((slot, C.DIR_INGRESS, cls))
+        # contributions: only rules whose selectors resolved a new identity
+        # can contribute new keys, and those keys differ from the rule's
+        # existing ones ONLY in the identity — filter the re-expansion on
+        # it and keep the per-rule records balanced for later removal
+        new_ids = {int(i.id) for i in new_idents}
+        for rule in self.repo.rules_selecting_identities(new_ids):
+            rec = self.rule_contribs.get(id(rule))
+            if rec is None:
+                continue    # added in THIS cycle's changelog: recorded
+                            # (with the new identities) by _apply_change
+            for slot, ep in enumerate(self.endpoints):
+                if slot not in rec["per_slot"]:
+                    continue           # rule does not select this endpoint
+                fresh = _norm_contribs(self.repo.expand_rule_for(rule, ep))
+                adds = [c for c in fresh if c[1].identity in new_ids]
+                for direction, key, norm in adds:
+                    self.planes[(slot, direction)].add(key, norm)
+                    dirty.add((slot, direction, key))
+                rec["per_slot"][slot].extend(adds)
+        patch.full_tensors.update(("verdict", "id_class_of",
+                                   "identity_ids"))
+        stats.new_identities = k
+        return forced
+
     def _ensure_port_boundaries(self, key: MapStateKey,
                                 patch: SnapshotPatch) -> int:
         """Split port classes so [key.port_lo, key.port_hi] is a union of
@@ -651,8 +779,9 @@ class IncrementalCompiler:
     # ------------------------------------------------------------------ #
     # snapshot emission
     # ------------------------------------------------------------------ #
-    def _emit(self, revision: int, ct_config,
-              l7_dirty: bool) -> PolicySnapshot:
+    def _emit(self, revision: int, ct_config, l7_dirty: bool,
+              lpm=None, ipcache: Optional[Dict[str, int]] = None,
+              ipcache_revision: Optional[int] = None) -> PolicySnapshot:
         from cilium_tpu.compile.policy_image import OverlayImage
         base = self.base
         if self._overlay and len(self._overlay) <= self.rebase_rows:
@@ -705,15 +834,17 @@ class IncrementalCompiler:
             image=image,
             id_classes=id_classes,
             port_classes=port_classes,
-            lpm=base.lpm,
+            lpm=lpm if lpm is not None else base.lpm,
             l7=l7_tensors,
             lb=base.lb,
             proto_family_table=base.proto_family_table,
             world_index=base.world_index,
             ct_config=ct_config or base.ct_config,
-            ipcache=base.ipcache,
+            ipcache=ipcache if ipcache is not None else base.ipcache,
             l7_interner=self.l7,
-            ipcache_revision=base.ipcache_revision,
+            ipcache_revision=(ipcache_revision
+                              if ipcache_revision is not None
+                              else base.ipcache_revision),
             services_revision=base.services_revision,
             enforcement_mode=base.enforcement_mode,
             allow_localhost=base.allow_localhost,

@@ -26,16 +26,201 @@ starts dropping.
 from __future__ import annotations
 
 import heapq
+import json
+import os
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
-from cilium_tpu.observe.observer import FlowFilter, FlowObserver
+import numpy as np
+
+from cilium_tpu.observe.observer import (FlowFilter, FlowObserver,
+                                         compose_mask)
 from cilium_tpu.runtime.flowlog import FlowLog
+from cilium_tpu.utils import constants as C
+from cilium_tpu.utils.ip import parse_addr
+
+_PROTO_IDS = {v: k for k, v in C.PROTO_NAMES.items()}
+_DIR_IDS = {v: k for k, v in C.DIR_NAMES.items()}
+
+
+class JsonlTailObserver:
+    """File-tail flow source: incrementally reads a node's flowlog JSONL
+    sink (the ``hubble export`` analog, ``DaemonConfig.flowlog_path``) and
+    serves the same ``observe()`` surface :class:`FlowObserver` does — the
+    cross-PROCESS/HOST transport for :class:`FlowRelay` (ISSUE 12: one
+    observer surface spans the mesh; each engine proc exports its flowlog
+    to a file, the relay host tails them all).
+
+    Loss stays explicit end to end: a bounded retained window that wraps
+    past a follower's cursor produces the same structured gap marker the
+    in-memory ring does, and a truncated/rotated file (resumed from byte
+    0) re-syncs rather than re-emitting old records."""
+
+    def __init__(self, path: str, capacity: int = 16384):
+        self.path = path
+        self.capacity = capacity
+        self._offset = 0               # byte offset consumed so far
+        self._partial = ""             # trailing unterminated line
+        self._records: deque = deque(maxlen=capacity)
+        self.newest_seq = 0
+        self._oldest_seq = 0           # oldest RETAINED seq (0 = none yet)
+        # a RESTARTED writer resets its ring seq to 1; the tail keeps its
+        # own monotonic stream by rebasing (synthetic seq = raw + base) —
+        # a seq regression is a restart, never a duplicate to drop
+        self._last_raw_seq = 0
+        self._seq_base = 0
+        self.writer_restarts = 0
+        self.parse_errors = 0
+        self.polls = 0
+
+    # lag duck-typing: FlowRelay reads ``obs.flowlog.newest_seq`` /
+    # ``len(obs.flowlog)`` — this source is its own ring
+    @property
+    def flowlog(self) -> "JsonlTailObserver":
+        return self
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def poll_file(self) -> int:
+        """Consume newly-appended bytes; returns records ingested."""
+        self.polls += 1
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0                   # not written yet / unreachable
+        if size < self._offset:
+            # truncated or rotated: resume from the top of the new file
+            self._offset = 0
+            self._partial = ""
+        if size == self._offset:
+            return 0
+        with open(self.path, "r") as f:
+            f.seek(self._offset)
+            data = f.read()
+            self._offset = f.tell()
+        data = self._partial + data
+        lines = data.split("\n")
+        self._partial = lines.pop()    # "" when data ended in \n
+        n = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                self.parse_errors += 1
+                continue
+            raw = int(rec.get("seq", 0))
+            if raw and raw <= self._last_raw_seq:
+                # seq regression = the WRITER restarted (a fresh engine's
+                # ring starts over at 1; same-process rotation keeps
+                # counting) — rebase so the tail's stream stays monotonic
+                # and the new session's records are kept, not dropped
+                self._seq_base = self.newest_seq
+                self.writer_restarts += 1
+            self._last_raw_seq = raw
+            if raw:
+                rec = dict(rec)
+                rec["seq"] = raw + self._seq_base
+            self._records.append(rec)  # deque(maxlen) evicts the oldest
+            self.newest_seq = max(self.newest_seq, int(rec.get("seq", 0)))
+            n += 1
+        if self._records:
+            self._oldest_seq = int(self._records[0].get("seq", 0))
+        return n
+
+    @staticmethod
+    def _cols_from(records: List[Dict]) -> Dict[str, np.ndarray]:
+        """Rendered records → the raw column dict FlowFilter masks read
+        (only built when filters are armed)."""
+        n = len(records)
+        cols = {
+            "seq": np.fromiter((r.get("seq", 0) for r in records),
+                               np.int64, n),
+            "time": np.fromiter((r.get("time", 0) for r in records),
+                                np.int64, n),
+            "allow": np.fromiter(
+                (r.get("verdict") == "FORWARDED" for r in records),
+                bool, n),
+            "reason": np.fromiter(
+                (r.get("drop_reason", 0) for r in records), np.int64, n),
+            "endpoint_id": np.fromiter(
+                (r.get("endpoint_id", -1) for r in records), np.int64, n),
+            "remote_identity": np.fromiter(
+                (r.get("remote_identity", -1) for r in records),
+                np.int64, n),
+            "proto": np.fromiter(
+                (_PROTO_IDS.get(r.get("proto"), -1) for r in records),
+                np.int64, n),
+            "sport": np.fromiter(
+                (r.get("src_port", -1) for r in records), np.int64, n),
+            "dport": np.fromiter(
+                (r.get("dst_port", -1) for r in records), np.int64, n),
+            "matched_rule": np.fromiter(
+                (r.get("matched_rule", -1) for r in records), np.int64, n),
+            "direction": np.fromiter(
+                (_DIR_IDS.get(r.get("direction"), -1) for r in records),
+                np.int64, n),
+        }
+        addr = np.zeros((n, 8), dtype=np.uint32)
+        for i, r in enumerate(records):
+            for base, key in ((0, "src_ip"), (4, "dst_ip")):
+                try:
+                    a16, _ = parse_addr(str(r.get(key, "")))
+                except (ValueError, OSError):
+                    continue
+                addr[i, base:base + 4] = np.frombuffer(a16, dtype=">u4")
+        cols["src"] = addr[:, :4]
+        cols["dst"] = addr[:, 4:]
+        return cols
+
+    def observe(self, allow: Sequence[FlowFilter] = (),
+                deny: Sequence[FlowFilter] = (),
+                last: int = 0, since: Optional[int] = None,
+                limit: int = 4096) -> Dict:
+        """Same contract as :meth:`FlowObserver.observe` (one-shot /
+        follow with explicit gap markers), over the tailed file."""
+        self.poll_file()
+        follow = since is not None
+        recs = list(self._records)
+        gap = None
+        if follow:
+            if since and since > 0 and self._oldest_seq > since + 1:
+                # same structured marker FlowLog.gap_marker emits: loss is
+                # a record in the stream, not cursor arithmetic
+                gap = {"gap": True, "dropped": self._oldest_seq - since - 1,
+                       "resume_seq": self._oldest_seq}
+            recs = [r for r in recs if int(r.get("seq", 0)) > since]
+        scanned = len(recs)
+        if recs and (allow or deny):
+            m = compose_mask(self._cols_from(recs), allow, deny)
+            recs = [r for r, keep in zip(recs, m) if keep]
+        matched = len(recs)
+        cap = last if (last and not follow) else limit
+        truncated = bool(cap and len(recs) > cap)
+        if truncated:
+            recs = recs[-cap:] if not follow else recs[:cap]
+        if follow and truncated and recs:
+            cursor = int(recs[-1].get("seq", since or 0))
+        else:
+            cursor = self.newest_seq
+        return {"flows": [dict(r) for r in recs], "cursor": cursor,
+                "gap": gap, "matched": matched, "scanned": scanned}
+
+    def stats(self) -> Dict:
+        return {"path": self.path, "offset": self._offset,
+                "retained": len(self._records),
+                "newest_seq": self.newest_seq,
+                "parse_errors": self.parse_errors, "polls": self.polls}
 
 
 class FlowRelay:
     def __init__(self, sources: Dict[str, object], metrics=None):
-        """``sources``: name → FlowObserver | FlowLog (flowlogs are
-        wrapped). Names become the ``node`` tag on merged records."""
+        """``sources``: name → FlowObserver | FlowLog (wrapped) |
+        JsonlTailObserver (or anything with the ``observe()`` contract).
+        Names become the ``node`` tag on merged records."""
         self.observers: Dict[str, FlowObserver] = {}
         for name, src in sources.items():
             if isinstance(src, FlowLog):

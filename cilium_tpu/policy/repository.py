@@ -209,6 +209,27 @@ class Repository:
         return len(self._rules)
 
     # -- resource materialization -------------------------------------------
+    def rules_selecting_identities(self, ident_ids) -> List[Rule]:
+        """Resident rules whose peer-side selectors currently resolve any of
+        ``ident_ids`` — the cheap prefilter for incremental identity growth
+        (compile/incremental, ISSUE 12): one set intersection per cached
+        selector, no rule expansion. Wildcard blocks are excluded: their
+        contribution key is ``IDENTITY_ANY``, which identity growth cannot
+        change."""
+        wanted = set(ident_ids)
+        with self._lock:
+            out: List[Rule] = []
+            for rule in self._rules:
+                res = self._resources.get(id(rule))
+                if res is None:
+                    continue
+                for block_res in res.blocks.values():
+                    if any(cached.identities & wanted
+                           for cached in block_res.selectors):
+                        out.append(rule)
+                        break
+            return out
+
     def _materialize(self, rule: Rule) -> _RuleResources:
         res = _RuleResources()
         for block in (rule.ingress + rule.ingress_deny

@@ -204,6 +204,10 @@ def status_doc(engine: "Engine") -> Dict:
         # vectorized flow-observe engine (observe/observer.py): query +
         # follow-gap accounting over the columnar flowlog ring
         "observer": engine.observer.stats(),
+        # None until a ClusterMesh is attached (cluster_store+node_name):
+        # per-peer generation/lag, store reachability, staleness verdict,
+        # conflict map, replication-lag p99 (runtime/clustermesh.status)
+        "mesh": engine.mesh_status(),
     }
 
 

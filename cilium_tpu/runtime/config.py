@@ -131,6 +131,13 @@ class DaemonConfig:
     cluster_store: str = ""        # shared store dir ("" = single-host)
     node_name: str = ""            # this node's name in the store
     cluster_sync_interval_s: float = 5.0
+    # peer lease: a peer whose generation stops progressing for this long
+    # (judged on OUR clock — skew-immune) is withdrawn (etcd lease analog)
+    cluster_stale_after_s: float = 60.0
+    # store-partition budget: no successful store pass for this long →
+    # health() degrades with the MESH_STALE detail (last-good remote state
+    # keeps serving throughout — partition never fails closed)
+    cluster_staleness_budget_s: float = 15.0
     # --- observability ---
     flowlog_capacity: int = 16384
     flowlog_mode: str = "drops"    # all | drops | none
@@ -285,6 +292,10 @@ class DaemonConfig:
         if self.slo_e2e_ms < 0:
             raise ValueError("slo_e2e_ms must be >= 0 (0 = no burn "
                              "counting)")
+        if self.cluster_stale_after_s <= 0 \
+                or self.cluster_staleness_budget_s <= 0:
+            raise ValueError("cluster_stale_after_s and "
+                             "cluster_staleness_budget_s must be > 0")
 
     # -- sources -------------------------------------------------------------
     @classmethod

@@ -1007,6 +1007,28 @@ class Engine:
         ov = self._overload
         return ov.status() if ov is not None else None
 
+    # -- multi-host sync (runtime/clustermesh.py) -------------------------------
+    def attach_mesh(self, store_dir: Optional[str] = None,
+                    node_name: Optional[str] = None):
+        """Create (or return) this engine's ClusterMesh WITHOUT starting the
+        sync controller — deterministic drivers (``bench.py --cluster``,
+        tests, chaos drills) tick ``mesh.step()`` themselves;
+        ``start_background`` wires the controller on top. Arguments default
+        to the config's ``cluster_store``/``node_name``."""
+        with self._lock:
+            if self._mesh is None:
+                from cilium_tpu.runtime.clustermesh import ClusterMesh
+                self._mesh = ClusterMesh(
+                    self, store_dir or self.config.cluster_store,
+                    node_name or self.config.node_name,
+                    stale_after_s=self.config.cluster_stale_after_s,
+                    staleness_budget_s=self.config.cluster_staleness_budget_s)
+            return self._mesh
+
+    def mesh_status(self) -> Optional[Dict]:
+        m = self._mesh
+        return m.status() if m is not None else None
+
     def start_background(self) -> None:
         """Start the periodic controllers and (when configured) the REST API
         server on its unix socket (SURVEY.md §3.1 "api server up")."""
@@ -1014,11 +1036,8 @@ class Engine:
             from cilium_tpu.runtime.api import APIServer
             self._api = APIServer(self, self.config.api_socket)
             self._api.start()
-        if (self.config.cluster_store and self.config.node_name
-                and self._mesh is None):
-            from cilium_tpu.runtime.clustermesh import ClusterMesh
-            self._mesh = ClusterMesh(self, self.config.cluster_store,
-                                     self.config.node_name)
+        if self.config.cluster_store and self.config.node_name:
+            self.attach_mesh()
             self.controllers.update(
                 "clustermesh-sync", self._mesh.step,
                 interval=self.config.cluster_sync_interval_s)
@@ -1140,6 +1159,24 @@ class Engine:
                 "last_mismatch_revision": aud.last_mismatch_revision,
             }
             if doc["state"] == C.HEALTH_OK:
+                doc["state"] = C.HEALTH_DEGRADED
+        mesh = self._mesh
+        if mesh is not None:
+            ms = mesh.status()
+            # MESH_STALE is a DETAIL, not a serving failure: classify keeps
+            # answering from last-good remote state (partition never fails
+            # closed on established remote flows) — but the operator must
+            # see that the remote view may be behind the mesh
+            doc["mesh"] = {
+                "state": ms["state"],
+                "store_ok": ms["store_ok"],
+                "peers": len(ms["peers"]),
+                "remote_entries": ms["remote_entries"],
+                "last_good_pass_age_s": ms["last_good_pass_age_s"],
+                "replication_lag_p99_s": ms["replication_lag_p99_s"],
+            }
+            if ms["state"] == C.MESH_STALE \
+                    and doc["state"] == C.HEALTH_OK:
                 doc["state"] = C.HEALTH_DEGRADED
         ov = self._overload
         if ov is not None:

@@ -103,6 +103,14 @@ POINTS: Dict[str, str] = {
                        "backoff — the ladder state must HOLD (no flap to "
                        "OK, no spurious escalation) while the decider "
                        "itself is failing",
+    "clustermesh.store_list": "the whole-store directory listing in "
+                              "ClusterMesh._read_peers (a dead NFS mount — "
+                              "the store PARTITION, vs peer_read's "
+                              "single-file flake): trips make the mesh "
+                              "serve last-good remote state and, past the "
+                              "staleness budget, degrade health with the "
+                              "MESH_STALE detail — never fail closed on "
+                              "established remote flows",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

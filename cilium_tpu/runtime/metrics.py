@@ -186,6 +186,13 @@ class Metrics:
         with self._lock:
             self.gauges[name] = value
 
+    def drop_gauge(self, name: str) -> None:
+        """Remove a labeled gauge whose subject is gone (e.g. a departed
+        clustermesh peer) — a frozen last value would keep exporting a
+        healthy-looking reading for a dead thing."""
+        with self._lock:
+            self.gauges.pop(name, None)
+
     def inc_counter(self, name: str, by: int = 1) -> None:
         """Named host-side counter (upstream: errors/warnings metrics —
         e.g. regeneration failures, sink drops)."""

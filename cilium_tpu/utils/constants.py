@@ -210,6 +210,11 @@ HEALTH_OK = "OK"
 HEALTH_DEGRADED = "DEGRADED"
 HEALTH_STALE = "STALE"
 HEALTH_STATES = (HEALTH_OK, HEALTH_DEGRADED, HEALTH_STALE)
+# Clustermesh staleness detail (runtime/clustermesh.status()): the store
+# has been unreachable past the staleness budget — remote state still
+# serves last-good (never fail closed on established remote flows), but
+# the view may be behind the mesh; folds Engine.health() to DEGRADED.
+MESH_STALE = "MESH_STALE"
 
 # --------------------------------------------------------------------------- #
 # L7-lite (config 4): tokenized HTTP method/path-prefix matching

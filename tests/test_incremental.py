@@ -249,18 +249,40 @@ class TestGeometryPaths:
         fresh = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
         assert_equivalent(inc_snap, fresh, make_probes(ctx, len(eps)))
 
-    def test_gates_fall_back(self):
-        """CIDR rules allocate identities → identity-set gate; ipcache and
-        service changes gate too."""
+    def test_identity_growth_absorbed_removal_gates(self):
+        """ISSUE 12: a CIDR rule allocating NEW identities (+ ipcache
+        entries) is absorbed incrementally — appended singleton classes +
+        an LPM rebuild in the patch, equivalent to a fresh build — while
+        identity REMOVAL (the rule deleted, identities released) still
+        gates to a full rebuild."""
         ctx, repo, eps = make_world()
         repo.add([l4_rule("web0", 0, 80)])
         snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
         inc = IncrementalCompiler(repo, ctx, eps, snap)
-        repo.add([parse_rule({
+        cidr = parse_rule({
             "endpointSelector": {"matchLabels": {"app": "web0"}},
-            "egress": [{"toCIDR": ["10.5.0.0/16"]}]})])
+            "egress": [{"toCIDR": ["10.5.0.0/16"]}]})
+        repo.add([cidr])
+        res = inc.try_update(CTConfig(capacity=1024))
+        assert res is not None, inc.last_fallback
+        inc_snap, patch, stats = res
+        assert stats.new_identities == 1
+        assert stats.lpm_rebuilt
+        assert {"verdict", "id_class_of", "identity_ids",
+                "lpm_v4", "lpm_v6"} <= patch.full_tensors
+        fresh = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
+        assert_equivalent(inc_snap, fresh, make_probes(ctx, len(eps)))
+        # the new CIDR identity resolves through the patched LPM exactly
+        # like the fresh build's
+        from cilium_tpu.compile.lpm import lpm_lookup_host
+        a16, _ = __import__("cilium_tpu.utils.ip", fromlist=["parse_addr"]
+                            ).parse_addr("10.5.1.2")
+        assert lpm_lookup_host(inc_snap.lpm, a16, False) \
+            == lpm_lookup_host(fresh.lpm, a16, False)
+        # removal: the rule's release shrinks the identity set → full build
+        repo.clear()
         assert inc.try_update(CTConfig(capacity=1024)) is None
-        assert inc.last_fallback == "identity-set-changed"
+        assert inc.last_fallback == "identity-removed"
 
 
 # --------------------------------------------------------------------------- #
