@@ -10,7 +10,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        ddos-smoke cluster-smoke shim bench clean
+        ddos-smoke cluster-smoke pressure-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -129,7 +129,24 @@ cluster-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_clustermesh.py -q -m slow
 	$(PYTEST_ENV) env CILIUM_TPU_CLUSTER_DATAPATH=fake python bench.py --cluster 3 --preset smoke > /tmp/cilium_tpu_cluster_gate.json
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke
+# Resource-pressure gate (ISSUE 13: observe/pressure.py ledger + the HBM
+# ledger): the tier-1 ledger subset — registration floor (≥12 resources),
+# CT-row-tracks-gauge exactness, ETA/forecast latching, RESOURCE_PRESSURE
+# health detail, the ladder's fourth latch, {resource=} scrape races,
+# register/deregister under engine restart, trace-ring drop accounting,
+# departed-shard/peer gauge sweeps, verifier budget doc, JIT HBM groups —
+# plus the slow-marked soaks: the cfg6-form storm (ct_table row bit-
+# identical to ct_occupancy every tick, time-to-exhaustion fired before
+# SHED-NEW, auditor clean at 1.0) and the 8-shard audited scrape-race soak
+# with a mid-soak watchdog restart (the PR 7/11 house pattern on the new
+# families). The full-scale acceptance rides `bench.py --ddos` (ddos-smoke
+# above), whose artifact now gates trajectory exactness, forecast-before-
+# SHED-NEW, and the <2% ledger-polling attestation.
+pressure-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_pressure.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_pressure.py -q -m slow
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -88,7 +88,7 @@ def _provenance(argv=None):
     cfg = {"argv": list(sys.argv[1:] if argv is None else argv),
            "env": {k: v for k, v in sorted(os.environ.items())
                    if k.startswith("CILIUM_TPU_")}}
-    return {
+    doc = {
         "git_rev": rev,
         "jax_version": jax_version,
         "platform": platform,
@@ -97,6 +97,17 @@ def _provenance(argv=None):
         "config": cfg,
         "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if _HBM_REPORT["budget"] is not None:
+        # offline verifier HBM budget (cilium-tpu verify --report FILE,
+        # embedded via --hbm-report): the artifact cites the same numbers
+        # the --max-hbm-bytes gate judged and the live ledger exports
+        doc["hbm_budget"] = _HBM_REPORT["budget"]
+    return doc
+
+
+#: `--hbm-report FILE` payload (the budget summary of a `cilium-tpu verify
+#: --report` sweep), stamped into every artifact's provenance when given
+_HBM_REPORT = {"budget": None}
 
 
 #: fields --compare judges, with direction: +1 higher-is-better
@@ -1012,6 +1023,68 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
     pre_fps = fps_of(12 if smoke else 24)
     eng.overload_step()
 
+    # -- phase 0b: ledger-overhead attestation (the PR 3 form) --------------
+    # D/A/D/A interleaved windows (disarmed / armed-with-polling) for the
+    # fps evidence, with the GATED number measured directly: wall time
+    # spent inside resource_step as a fraction of the armed windows'
+    # serving time. The armed cadence — one full ledger sweep per
+    # dozen-batch window, the storm loop's own per-iteration rhythm — is
+    # still ~250x denser per served row than the production controller's
+    # resource_interval_s, so a pass bounds the real overhead from far
+    # above. (The fps delta alone flakes: window-to-window variance on a
+    # shared CPU rig is several percent, an order above the poll cost —
+    # the ratio-of-measured-times form is what "<2% of armed serving
+    # time" actually states.)
+    att_w = 12 if smoke else 24
+    att_fps = {"off": [], "on": []}
+    att_poll_s = att_armed_s = 0.0
+    for mode in ("off", "on", "off", "on"):
+        t0 = time.monotonic()
+        for i in range(att_w):
+            run_legit(1)
+            if mode == "on" and i % 12 == 11:
+                p0 = time.monotonic()
+                eng.resource_step(now=float(L[0]))
+                att_poll_s += time.monotonic() - p0
+        dt = max(time.monotonic() - t0, 1e-9)
+        att_fps[mode].append(att_w * n_legit / dt)
+        if mode == "on":
+            att_armed_s += dt
+    att_off = sum(att_fps["off"]) / len(att_fps["off"])
+    att_on = sum(att_fps["on"]) / len(att_fps["on"])
+    att_overhead_pct = 100.0 * att_poll_s / max(att_armed_s, 1e-9)
+    pressure_attestation = {
+        "fps_disarmed": round(att_off, 1),
+        "fps_armed": round(att_on, 1),
+        "fps_delta_pct": round(
+            max(0.0, (1.0 - att_on / max(att_off, 1e-9)) * 100), 2),
+        "poll_s": round(att_poll_s, 4),
+        "armed_serving_s": round(att_armed_s, 4),
+        "overhead_pct": round(att_overhead_pct, 2),
+        "budget_pct": 2.0,
+        "ok": att_overhead_pct < 2.0,
+    }
+
+    # per-iteration ledger polling through the storm (logical clock →
+    # deterministic ETA math): the cfg6 acceptance gates — the CT resource
+    # row must track the ct_occupancy gauge EXACTLY, and the
+    # time-to-exhaustion forecast must fire before the ladder reaches
+    # SHED-NEW (forecast-then-shed is the ledger doing its job; shed
+    # without forecast means the forecast is useless under attack)
+    ct_track_mismatches = 0
+    forecast_iter = shed_new_iter = None
+
+    def poll_ledger(it_now: int):
+        nonlocal ct_track_mismatches, forecast_iter
+        rep = eng.resource_step(now=float(L[0]))
+        row = rep["resources"].get("ct_table")
+        gauge = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
+        if row is None or row["pressure"] != gauge:
+            ct_track_mismatches += 1
+        if forecast_iter is None and row is not None and row["forecast"]:
+            forecast_iter = it_now
+        return rep
+
     # -- phase 1a: CT saturation burst --------------------------------------
     # the flood fully processed (drained per iteration): the table fills
     # past ct_pressure_high, emergency GC arms and bounds occupancy, tail
@@ -1041,6 +1114,7 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
         max_level = max(max_level, st["level"])
         eng.sweep_step(now=L[0])
         eng.audit_step(budget=16)
+        poll_ledger(it)
         occ = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
         occ_trajectory.append((it, occ))
         if occ >= cfg.ct_pressure_high:
@@ -1081,8 +1155,11 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
         st = eng.overload_step()
         if st["level"] >= OVERLOAD_SHED_NEW:
             shed_new_iters += 1
+            if shed_new_iter is None:
+                shed_new_iter = it
         eng.sweep_step(now=L[0])
         eng.audit_step(budget=16)
+        poll_ledger(it)
         occ_trajectory.append(
             (it, float(eng.metrics.gauges.get("ct_occupancy", 0.0))))
     pump_legit(block_s=120.0)         # storm stragglers resolve now
@@ -1105,6 +1182,14 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
     occ_final = float(eng.metrics.gauges.get("ct_occupancy", 0.0))
     post_fps = fps_of(12 if smoke else 24)
     ladder = eng.overload_status() or {}
+    # final ledger sweep: the artifact carries every resource's high-water
+    # through the storm + the device-memory ledger (ROADMAP item 6's
+    # hardware-truth landing zone — re-baselined per-group on a real v5e)
+    final_rep = eng.resource_step(now=float(L[0]))
+    resource_high_water = {
+        r: d["high_water"] for r, d in final_rep["resources"].items()}
+    hbm_ledger = eng.datapath.hbm_ledger() \
+        if hasattr(eng.datapath, "hbm_ledger") else None
 
     # -- drain + audit ------------------------------------------------------
     drained = eng.drain(timeout=120)
@@ -1159,6 +1244,21 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
         gate_reasons.append(
             f"post-storm throughput collapsed: {post_fps:.0f} vs "
             f"pre-storm {pre_fps:.0f} (ratio {post_ratio:.3f} < 1/1.2)")
+    if ct_track_mismatches:
+        gate_reasons.append(
+            f"resource ledger: ct_table pressure diverged from the "
+            f"ct_occupancy gauge on {ct_track_mismatches} poll(s)")
+    if forecast_iter is None:
+        gate_reasons.append(
+            "resource ledger: time-to-exhaustion never fired for ct_table")
+    elif shed_new_iter is not None and forecast_iter >= shed_new_iter:
+        gate_reasons.append(
+            f"resource ledger: forecast fired at iter {forecast_iter}, "
+            f"after SHED-NEW at iter {shed_new_iter}")
+    if not pressure_attestation["ok"]:
+        gate_reasons.append(
+            f"ledger polling overhead {att_overhead_pct:.2f}% > 2% of "
+            "armed serving time")
 
     if verbose:
         print(f"# ddos preset={preset} iters={it} survival="
@@ -1224,6 +1324,17 @@ def ddos_bench(preset: str, verbose: bool = False, batch: int = 256):
         },
         "pre_storm_rows": pre_rows0,
         "drained": bool(drained),
+        "resources": {
+            "registered": len(final_rep["resources"]),
+            "high_water": resource_high_water,
+            "ct_trajectory_exact": ct_track_mismatches == 0,
+            "forecast_iter": forecast_iter,
+            "shed_new_iter": shed_new_iter,
+            "forecasts_total": final_rep["forecasts_total"],
+            "exhaustions_total": final_rep["exhaustions_total"],
+        },
+        "hbm_ledger": hbm_ledger,
+        "pressure_attestation": pressure_attestation,
         "ddos_gate": {
             "failed": bool(gate_reasons),
             **({"reasons": gate_reasons} if gate_reasons else {}),
@@ -2792,6 +2903,11 @@ def main(argv=None):
     ap.add_argument("--fused", default="auto", choices=["auto", "on", "off"],
                     help="with --kernels: fused-kernel selector resolved "
                          "exactly like DaemonConfig.fused_kernels")
+    ap.add_argument("--hbm-report", metavar="VERIFY.json",
+                    help="embed a `cilium-tpu verify --report` sweep's HBM "
+                         "budget summary into the artifact's provenance "
+                         "(offline --max-hbm-bytes verification and the "
+                         "live HBM ledger citing the same numbers)")
     ap.add_argument("--compare", metavar="OLD.json",
                     help="diff this run against a prior JSON artifact "
                          "(pack/fps/e2e ratio-checked against "
@@ -2814,6 +2930,9 @@ def main(argv=None):
                          "to DIR (jax.profiler.trace)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.hbm_report:
+        with open(args.hbm_report) as f:
+            _HBM_REPORT["budget"] = json.load(f).get("budget")
 
     import os
 

@@ -173,6 +173,23 @@ def register(sub: "argparse._SubParsersAction") -> None:
     p.set_defaults(func=_cmd_trace)
 
     p = sub.add_parser(
+        "top", help="live resource-pressure view of a running agent "
+                    "(observe/pressure.py ledger): one row per bounded "
+                    "structure — occupancy bar, pressure, high-water, "
+                    "time-to-exhaustion — plus the device HBM ledger. "
+                    "Refreshes until interrupted; --once prints a single "
+                    "frame (scriptable)")
+    p.add_argument("--api", metavar="SOCKET", required=True,
+                   help="the running engine's REST socket")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("-o", "--output", choices=["text", "json"],
+                   default="text")
+    p.set_defaults(func=_cmd_top)
+
+    p = sub.add_parser(
         "debug-bundle",
         help="fetch the flight-recorder debug bundle from a live agent "
              "(observe/blackbox.py): the frozen anomaly bundle — parity "
@@ -221,6 +238,11 @@ def register(sub: "argparse._SubParsersAction") -> None:
                    help="fail combos whose argument+temp memory exceeds this")
     p.add_argument("--quick", action="store_true",
                    help="skip the LB axis (faster pre-merge check)")
+    p.add_argument("--report", metavar="FILE",
+                   help="write the sweep + HBM budget summary as JSON "
+                        "(embed into bench artifacts via --hbm-report so "
+                        "offline verification and the live ledger cite "
+                        "the same numbers)")
     p.set_defaults(func=_cmd_verify)
 
     p = sub.add_parser(
@@ -921,7 +943,13 @@ def _cmd_trace(args) -> int:
               "CILIUM_TPU_TRACE_SAMPLE_RATE=0.015625 for 1/64)")
     print(f"sampled={st.get('sampled_total')} "
           f"in_ring={st.get('spans_in_ring')}/{st.get('capacity')} "
-          f"rate={st.get('sample_rate')}")
+          f"rate={st.get('sample_rate')} "
+          f"dropped={st.get('spans_dropped_total', 0)} "
+          f"wraps={st.get('ring_wraps', 0)}")
+    if st.get("spans_dropped_total"):
+        print(f"** {st['spans_dropped_total']} spans lost to ring "
+              f"wraparound ({st.get('ring_wraps', 0)} full wraps) — the "
+              "summary below covers only the surviving tail **")
     summary = doc.get("summary", {})
     if summary:
         print(f"{'stage':<24} {'count':>7} {'p50 ms':>10} {'p99 ms':>10} "
@@ -1052,7 +1080,8 @@ def _cmd_classify(args) -> int:
 
 
 def _cmd_verify(args) -> int:
-    from cilium_tpu.compile.verifier import verify_configs
+    import dataclasses
+    from cilium_tpu.compile.verifier import budget_doc, verify_configs
     reports = verify_configs(batch=args.batch,
                              max_hbm_bytes=args.max_hbm_bytes,
                              quick=args.quick)
@@ -1062,8 +1091,95 @@ def _cmd_verify(args) -> int:
                f"out={r.output_bytes}" if r.ok else r.error)
         print(f"{'OK  ' if r.ok else 'FAIL'} {r.name:<24} {mem}")
         bad += not r.ok
+    budget = budget_doc(reports, max_hbm_bytes=args.max_hbm_bytes)
     print(f"{len(reports) - bad}/{len(reports)} combos verifier-accepted")
+    if budget["worst_combo"]:
+        print(f"hbm budget: worst={budget['worst_combo']} "
+              f"arg+temp={budget['worst_total_bytes']}"
+              + (f" (budget {args.max_hbm_bytes})"
+                 if args.max_hbm_bytes else ""))
+    if getattr(args, "report", None):
+        with open(args.report, "w") as f:
+            json.dump({"budget": budget,
+                       "reports": [dataclasses.asdict(r)
+                                   for r in reports]}, f, indent=2)
+        print(f"verify report written to {args.report}")
     return 1 if bad else 0
+
+
+_BAR_W = 24
+
+
+def _pressure_bar(pressure: float) -> str:
+    filled = max(0, min(_BAR_W, int(round(pressure * _BAR_W))))
+    return "[" + "#" * filled + "." * (_BAR_W - filled) + "]"
+
+
+def _fmt_qty(v: float) -> str:
+    """Compact quantity: 1.2M rows / 3.4G bytes read the same way."""
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.1f}{unit}"
+    return f"{v:.0f}" if float(v).is_integer() else f"{v:.1f}"
+
+
+def _fmt_eta(eta_s) -> str:
+    if eta_s is None:
+        return "-"
+    if eta_s >= 3600:
+        return f"{eta_s / 3600:.1f}h"
+    if eta_s >= 60:
+        return f"{eta_s / 60:.1f}m"
+    return f"{eta_s:.0f}s"
+
+
+def _top_frame(doc: dict) -> str:
+    lines = [f"{'resource':<22} {'pressure':<{_BAR_W + 2}} {'occ':>8} "
+             f"{'cap':>8} {'high':>8} {'eta':>7}  fc"]
+    rows = doc.get("resources", {})
+    order = sorted(rows, key=lambda r: -rows[r]["pressure"])
+    for name in order:
+        d = rows[name]
+        lines.append(
+            f"{name:<22} {_pressure_bar(d['pressure'])} "
+            f"{_fmt_qty(d['occupancy']):>8} {_fmt_qty(d['capacity']):>8} "
+            f"{_fmt_qty(d['high_water']):>8} {_fmt_eta(d['eta_s']):>7}  "
+            f"{'!' if d.get('forecast') else ''}")
+    lines.append(
+        f"max_pressure={doc.get('max_pressure')} "
+        f"pressured={','.join(doc.get('pressured', [])) or '-'} "
+        f"forecasts={doc.get('forecasts_total', 0)} "
+        f"polls={doc.get('polls_total', 0)}")
+    hbm = (doc.get("hbm") or {}).get("ledger")
+    if hbm:
+        groups = " ".join(f"{k}={_fmt_qty(v)}B"
+                          for k, v in sorted(hbm["groups"].items()) if v)
+        lines.append(f"hbm: device={_fmt_qty(hbm['device_bytes'])}B "
+                     f"({groups}) places={hbm['places_total']} "
+                     f"patches={hbm['patches_total']}")
+    return "\n".join(lines)
+
+
+def _cmd_top(args) -> int:
+    """The live capacity view (`cilium-tpu top`): one row per ledger
+    resource, worst pressure first. Exit 0; --once makes it scriptable.
+    Ctrl-C anywhere in the refresh loop (including mid-fetch against a
+    slow agent) is the normal clean exit."""
+    import time as _time
+    try:
+        while True:
+            doc = _live(args, "GET", "/v1/resources")
+            if args.output == "json":
+                print(json.dumps(doc, indent=2, default=str))
+            else:
+                if not args.once:
+                    sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+                print(_top_frame(doc))
+            if args.once:
+                return 0
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cmd_map_get(args) -> int:

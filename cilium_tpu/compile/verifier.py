@@ -86,7 +86,12 @@ def _build_world(l7: bool, lb: bool, v6: bool):
     return build_snapshot(repo, ctx, [ep], CTConfig(capacity=1 << 10))
 
 
-def _memory_stats(compiled) -> Dict[str, int]:
+def memory_stats(compiled) -> Dict[str, int]:
+    """Bytes a compiled XLA executable needs, via ``memory_analysis()`` —
+    the machinery both the offline budget check here and the live HBM
+    ledger (runtime/datapath.hbm_ledger, ISSUE 13) cite: argument bytes are
+    the placed tensors the ledger accounts group by group; temp bytes are
+    the compiler's scratch on top."""
     try:
         m = compiled.memory_analysis()
         return {
@@ -96,6 +101,32 @@ def _memory_stats(compiled) -> Dict[str, int]:
         }
     except Exception:
         return {"argument_bytes": 0, "temp_bytes": 0, "output_bytes": 0}
+
+
+_memory_stats = memory_stats           # pre-ISSUE-13 private name
+
+
+def budget_doc(reports: List[ComboReport],
+               max_hbm_bytes: Optional[int] = None) -> Dict:
+    """Summarize one verify sweep into the HBM budget report that
+    ``status_doc`` and bench-artifact provenance embed (ISSUE 13 satellite:
+    offline ``--max-hbm-bytes`` verification and the live ledger citing
+    the same numbers). Pure function of the reports — reusable on a sweep
+    loaded back from a ``cilium-tpu verify --report`` file."""
+    ok = [r for r in reports if r.ok]
+    worst = max(ok, key=lambda r: r.argument_bytes + r.temp_bytes,
+                default=None)
+    return {
+        "combos": len(reports),
+        "accepted": len(ok),
+        "rejected": [r.name for r in reports if not r.ok],
+        "max_hbm_bytes": max_hbm_bytes,
+        "worst_combo": worst.name if worst is not None else None,
+        "worst_argument_bytes": worst.argument_bytes if worst else 0,
+        "worst_temp_bytes": worst.temp_bytes if worst else 0,
+        "worst_total_bytes": (worst.argument_bytes + worst.temp_bytes)
+        if worst else 0,
+    }
 
 
 def verify_configs(batch: int = 256,

@@ -53,8 +53,13 @@ log = logging.getLogger("cilium_tpu.blackbox")
 #: arrive as kind="watchdog" (the action attr distinguishes them).
 #: Overload-ladder transitions (kind="overload") and CT-emergency-GC
 #: events (kind="ct-emergency") are recorded but never freeze: they are
-#: COMMANDED degradation, the system doing its job under attack.
-FREEZE_KINDS = frozenset(("watchdog", "parity-mismatch"))
+#: COMMANDED degradation, the system doing its job under attack. Resource-
+#: ledger forecasts (kind="resource-pressure", ISSUE 13) likewise only
+#: narrate — but a forecast-then-exhaustion ("resource-exhaustion": the
+#: ledger predicted the structure would fill and then it did) is the
+#: capacity anomaly this recorder exists for, and freezes strictly.
+FREEZE_KINDS = frozenset(("watchdog", "parity-mismatch",
+                          "resource-exhaustion"))
 
 #: shed reasons judged against the RELAXED spike threshold: deliberate
 #: overload shedding (admission priority eviction, harvest-time SHED-NEW,
@@ -252,6 +257,7 @@ class FlightRecorder:
         with self._lock:
             return {
                 "events_in_ring": len(self._events),
+                "events_capacity": self._events.maxlen,
                 "events_total": self.events_total,
                 "verdict_summaries": len(self._verdicts),
                 "freezes_total": self.freezes_total,

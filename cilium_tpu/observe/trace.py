@@ -141,6 +141,12 @@ class Tracer:
         self._filled = 0           # occupied ring slots (O(1) stats read)
         self.sampled_total = 0     # counter-sampled events (maybe_sample)
         self.forced_total = 0      # always-traced events (regen, autotune)
+        # drop-oldest accounting (ISSUE 13): the ring used to wrap
+        # silently — a span summary over a storm looked complete while
+        # thousands of spans had been overwritten. Every overwritten slot
+        # counts; wraps counts full ring cycles.
+        self.spans_dropped_total = 0
+        self.ring_wraps = 0
         self.configure(sample_rate=sample_rate, capacity=capacity)
 
     # -- configuration -------------------------------------------------------
@@ -181,6 +187,8 @@ class Tracer:
             self._filled = 0
             self.sampled_total = 0
             self.forced_total = 0
+            self.spans_dropped_total = 0
+            self.ring_wraps = 0
             self._events = itertools.count()
             self._trace_ids = itertools.count(1)
 
@@ -226,10 +234,21 @@ class Tracer:
             return
         with self._lock:
             ring = self._ring
-            if ring[self._widx] is None:
+            overwrote = ring[self._widx] is not None
+            if not overwrote:
                 self._filled += 1
+            else:
+                # drop-oldest: the evicted span is LOST to every later
+                # summary/export — count it so /v1/trace can say how much
+                # of the story the ring no longer holds
+                self.spans_dropped_total += 1
             ring[self._widx] = (trace_id, name, t0, duration_s, attrs)
             self._widx = (self._widx + 1) % len(ring)
+            # a wrap is a completed cycle of LOSS, so the initial free
+            # fill doesn't count — keeps drops == wraps * capacity (+
+            # the partial cycle) mutually consistent
+            if self._widx == 0 and overwrote:
+                self.ring_wraps += 1
 
     def event(self, name: str, **attrs) -> Optional[int]:
         """Record a zero-duration decision event (always, when enabled)."""
@@ -303,6 +322,11 @@ class Tracer:
             "forced_total": self.forced_total,
             "spans_in_ring": recorded,
             "capacity": capacity,
+            # drop-oldest accounting (ISSUE 13): spans overwritten before
+            # any export saw them + full ring cycles — the "how much of
+            # the story is gone" fields the CLI prints
+            "spans_dropped_total": self.spans_dropped_total,
+            "ring_wraps": self.ring_wraps,
         }
 
 

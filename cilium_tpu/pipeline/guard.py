@@ -270,6 +270,7 @@ class OverloadLadder:
     def __init__(self, *, queue_high: float = 0.75, queue_low: float = 0.25,
                  shed_high: float = 50.0, shed_low: float = 5.0,
                  ct_high: float = 0.85, ct_low: float = 0.6,
+                 resource_high: float = 0.9, resource_low: float = 0.7,
                  up_ticks: int = 2, down_ticks: int = 6):
         if not (0.0 <= queue_low < queue_high <= 1.0):
             raise ValueError("need 0 <= queue_low < queue_high <= 1")
@@ -277,14 +278,19 @@ class OverloadLadder:
             raise ValueError("need 0 <= shed_low < shed_high")
         if not (0.0 <= ct_low < ct_high <= 1.0):
             raise ValueError("need 0 <= ct_low < ct_high <= 1")
+        if not (0.0 <= resource_low < resource_high <= 1.0):
+            raise ValueError("need 0 <= resource_low < resource_high <= 1")
         if up_ticks < 1 or down_ticks < 1:
             raise ValueError("up_ticks and down_ticks must be >= 1")
-        self._hi = {"queue": queue_high, "shed": shed_high, "ct": ct_high}
-        self._lo = {"queue": queue_low, "shed": shed_low, "ct": ct_low}
+        self._hi = {"queue": queue_high, "shed": shed_high, "ct": ct_high,
+                    "resource": resource_high}
+        self._lo = {"queue": queue_low, "shed": shed_low, "ct": ct_low,
+                    "resource": resource_low}
         self._up_ticks = up_ticks
         self._down_ticks = down_ticks
         self._lock = threading.Lock()
-        self._lit = {"queue": False, "shed": False, "ct": False}
+        self._lit = {"queue": False, "shed": False, "ct": False,
+                     "resource": False}
         self._last: Dict[str, float] = {}
         self.state = 0
         self._up = 0
@@ -302,19 +308,30 @@ class OverloadLadder:
         return self._lit[name]
 
     def observe(self, queue_frac: float, shed_rate: float,
-                ct_occupancy: float) -> Tuple[int, bool]:
-        """One control interval. Returns (state, changed)."""
+                ct_occupancy: float,
+                resource_pressure: float = 0.0) -> Tuple[int, bool]:
+        """One control interval. Returns (state, changed).
+        ``resource_pressure`` (ISSUE 13) is the resource ledger's worst
+        non-CT pressure fraction — a fourth latch, so a wire pool / patch
+        budget / ring running hot counts toward severity exactly like the
+        original three signals (default 0.0 keeps three-signal callers'
+        behavior bit-identical)."""
         with self._lock:
             sev = sum((self._latch("queue", queue_frac),
                        self._latch("shed", shed_rate),
-                       self._latch("ct", ct_occupancy)))
+                       self._latch("ct", ct_occupancy),
+                       self._latch("resource", resource_pressure)))
             self._last = {"queue_frac": round(queue_frac, 4),
                           "shed_rate": round(shed_rate, 2),
                           "ct_occupancy": round(ct_occupancy, 4),
+                          "resource_pressure": round(resource_pressure, 4),
                           "severity": sev}
             old = self.state
-            escalate = (sev > self.state
-                        or (sev >= 2 and self.state < OVERLOAD_SHED_NEW))
+            # SHED-NEW is the top rung: with four latchable signals the
+            # severity can reach 4, and an unbounded climb would step past
+            # the state table exactly when shedding matters most
+            escalate = (self.state < OVERLOAD_SHED_NEW
+                        and (sev > self.state or sev >= 2))
             calm = sev < self.state and sev < 2
             if escalate:
                 self._up += 1

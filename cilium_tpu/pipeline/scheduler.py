@@ -700,6 +700,14 @@ class Pipeline:
             self._settle([(t, None, PipelineError(
                 "pipeline closed before this submission resolved"))
                 for t in stranded])
+        # departed-subject gauge sweep (ISSUE 13): a closed pipeline's
+        # per-shard staged-rows series would otherwise export their last
+        # fills forever — and after a mesh resize (engine restarted onto a
+        # different shard count) the old shard labels would pin a gauge no
+        # live structure backs. Same drop_gauge sweep departed clustermesh
+        # peers and deregistered ledger resources get.
+        for name in self._shard_gauge_names:
+            self.metrics.drop_gauge(name)
 
     # -- runtime-tunable knobs (observe/autotune.py + chaos consumers) --------
     @property
@@ -758,6 +766,29 @@ class Pipeline:
         if self.breaker.state != "closed":
             return "breaker-open"
         return "ok"
+
+    def occupancy_stats(self) -> Dict:
+        """The bounded-structure subset of :meth:`stats` for the resource
+        ledger's per-poll sweep — no histogram quantile math, one lock
+        acquisition (the <2% ledger-polling attestation is the budget)."""
+        with self._lock:
+            pub = self._pub
+            return {
+                "queue_depth": len(self._queue),
+                "queue_max": self._queue_max,
+                "n_shards": self._n_shards,
+                # aggregate staging rows: n_shards * seg_cap when sharded
+                # (seg_cap carries headroom, so this exceeds max_bucket)
+                "stage_rows": self._stage_rows,
+                "shard_capacity": self._seg_cap,
+                "shard_fill": list(pub.get("shard_fill",
+                                           [0] * self._n_shards)),
+                "staged_rows": pub.get("staged_rows", 0),
+                "staging_free": pub.get("staging_free",
+                                        self._inflight_max + 1),
+                "staging_slots": pub.get("staging_slots",
+                                         self._inflight_max + 1),
+            }
 
     def stats(self) -> Dict:
         with self._lock:

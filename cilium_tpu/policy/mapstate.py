@@ -177,6 +177,19 @@ class _OverlayEntries:
         yield from self.over.items()
 
 
+#: process-wide overlay occupancy accounting (ISSUE 13): MapState overlays
+#: are per-(endpoint, direction) and transient, so the resource ledger
+#: samples this module-level aggregate instead of chasing instances —
+#: last/max dirty-key counts seen at overlay_copy time and how often the
+#: fold budget actually forced an O(entries) flatten.
+_OVERLAY_STATS = {"last_dirty": 0, "max_dirty": 0, "folds": 0, "copies": 0}
+
+
+def overlay_stats() -> Dict[str, int]:
+    """The mapstate-overlay ledger sample: (dirty keys vs fold budget)."""
+    return {"fold_budget": MapState.OVERLAY_FOLD_KEYS, **_OVERLAY_STATS}
+
+
 class MapState:
     """Mutable builder + queryable container of MapState entries."""
 
@@ -198,13 +211,20 @@ class MapState:
             else fold_budget
         ms = MapState()
         e = self._entries
+        _OVERLAY_STATS["copies"] += 1
         if isinstance(e, _OverlayEntries):
-            if e.dirty() > budget:
+            dirty = e.dirty()
+            _OVERLAY_STATS["last_dirty"] = dirty
+            _OVERLAY_STATS["max_dirty"] = max(_OVERLAY_STATS["max_dirty"],
+                                              dirty)
+            if dirty > budget:
+                _OVERLAY_STATS["folds"] += 1
                 ms._entries = _OverlayEntries(e.flatten())
             else:
                 ms._entries = _OverlayEntries(e.base, dict(e.over),
                                               set(e.dead))
         else:
+            _OVERLAY_STATS["last_dirty"] = 0
             ms._entries = _OverlayEntries(e)
         return ms
 

@@ -36,7 +36,16 @@ Routes (all under /v1):
   GET  /v1/flows/metrics?last=N     windowed flow-metrics time-series +
                               cumulative totals (the hubble metrics analog)
   GET  /v1/trace?limit=N&name=S     sampled span ring + per-stage summary
-                              (observe/trace.py; empty when tracing is off)
+                              (observe/trace.py; empty when tracing is off;
+                              stats carry spans_dropped_total + ring_wraps —
+                              the drop-oldest loss accounting)
+  GET  /v1/resources          resource pressure ledger (observe/pressure.py):
+                              one row per registered bounded structure —
+                              capacity, occupancy, pressure, high-water,
+                              time-to-exhaustion forecast — plus the
+                              device-side HBM ledger (bytes per placed
+                              tensor group) and any attached offline
+                              verifier budget report. Backs `cilium-tpu top`
   GET  /v1/debug/bundle?clear=1     flight-recorder debug bundle
                               (observe/blackbox.py): the frozen anomaly
                               bundle when one exists (parity mismatch,
@@ -204,6 +213,13 @@ def status_doc(engine: "Engine") -> Dict:
         # vectorized flow-observe engine (observe/observer.py): query +
         # follow-gap accounting over the columnar flowlog ring
         "observer": engine.observer.stats(),
+        # resource pressure ledger summary (observe/pressure.py): the
+        # pressured list + soonest exhaustion forecast without the full
+        # per-resource table (/v1/resources has that)
+        "resources": engine.ledger.status(),
+        # device-memory truth (ISSUE 13 satellite): the live HBM ledger
+        # and the offline verifier budget report cite the same numbers
+        "hbm": engine.hbm_status(),
         # None until a ClusterMesh is attached (cluster_store+node_name):
         # per-peer generation/lag, store reachability, staleness verdict,
         # conflict map, replication-lag p99 (runtime/clustermesh.status)
@@ -516,6 +532,8 @@ class _Handler(BaseHTTPRequestHandler):
                         int(q.get("last", 0))),
                     "totals": eng.flowmetrics.totals(),
                 })
+            if path == "/v1/resources":
+                return self._send_json(200, eng.resources())
             if path == "/v1/debug/bundle":
                 return self._send_json(200, eng.debug_bundle(
                     clear=q.get("clear") in ("1", "true")))

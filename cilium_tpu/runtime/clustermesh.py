@@ -519,6 +519,13 @@ class ClusterMesh:
         unlink is LOUD: a node that cannot withdraw looks exactly like one
         that did to every peer — until the lease expires — so the failure
         is logged and counted instead of silently swallowed."""
+        # departed-subject gauge sweep (ISSUE 13): detaching the mesh
+        # deregisters every peer this node was tracking — their lag gauges
+        # must go with them (expiry/tombstone paths already sweep their own
+        # peer; a withdraw mid-tracking would otherwise pin every live
+        # peer's last lag forever)
+        for node in list(self._last_good) + list(self._ingested):
+            self._drop_peer_gauge(node)
         path = os.path.join(self.store_dir, f"{self.node_name}.json")
         try:
             os.unlink(path)

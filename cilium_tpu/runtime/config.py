@@ -178,6 +178,29 @@ class DaemonConfig:
     # threshold so a commanded SHED-NEW storm cannot freeze the recorder
     # every window, while flush/steer_overflow keep the strict one above
     blackbox_shed_spike_relaxed: int = 4096
+    # --- resource pressure ledger (observe/pressure.py; ISSUE 13) ---
+    # every bounded structure registers (capacity, occupancy, high_water)
+    # with the central ledger; the resource-ledger controller polls at
+    # resource_interval_s, exporting the labeled resource_* gauge families
+    # and a windowed time-to-exhaustion forecast per resource. warn feeds
+    # the RESOURCE_PRESSURE health detail (and the forecast gate); crit
+    # degrades health(); an ETA under resource_eta_warn_s fires the
+    # resource-pressure flight-recorder event (strict freeze only on
+    # forecast-then-exhaustion). overload_resource_{high,low} is the
+    # ladder's fourth latch signal (max non-CT pressure).
+    resource_ledger_enabled: bool = True
+    resource_interval_s: float = 2.0
+    resource_pressure_warn: float = 0.8
+    resource_pressure_crit: float = 0.95
+    resource_eta_window: int = 16        # (t, occupancy) samples per ETA fit
+    resource_eta_warn_s: float = 120.0   # forecast threshold (seconds)
+    overload_resource_high: float = 0.9
+    overload_resource_low: float = 0.7
+    # device-memory budget for the live HBM ledger's `hbm` resource row
+    # (JIT backends only; 0 = report without a budget). The OFFLINE check
+    # stays `cilium-tpu verify --max-hbm-bytes` — same machinery, one
+    # number (compile/verifier.py budget_doc).
+    max_hbm_bytes: int = 0
     # --- end-to-end latency SLO (shim harvest → verdict apply) ---
     # burn threshold for ingest_e2e_slo_burn_total (+{shard=...}); 0 keeps
     # the e2e histograms exporting but disables burn counting
@@ -289,6 +312,22 @@ class DaemonConfig:
                              "blackbox_shed_spike must be >= 1")
         if self.blackbox_shed_window_s <= 0:
             raise ValueError("blackbox_shed_window_s must be > 0")
+        if self.resource_interval_s <= 0:
+            raise ValueError("resource_interval_s must be > 0")
+        if not 0.0 < self.resource_pressure_warn \
+                < self.resource_pressure_crit <= 1.0:
+            raise ValueError("need 0 < resource_pressure_warn < "
+                             "resource_pressure_crit <= 1")
+        if self.resource_eta_window < 2:
+            raise ValueError("resource_eta_window must be >= 2")
+        if self.resource_eta_warn_s <= 0:
+            raise ValueError("resource_eta_warn_s must be > 0")
+        if not 0.0 <= self.overload_resource_low \
+                < self.overload_resource_high <= 1.0:
+            raise ValueError("need 0 <= overload_resource_low < "
+                             "overload_resource_high <= 1")
+        if self.max_hbm_bytes < 0:
+            raise ValueError("max_hbm_bytes must be >= 0 (0 = no budget)")
         if self.slo_e2e_ms < 0:
             raise ValueError("slo_e2e_ms must be >= 0 (0 = no burn "
                              "counting)")

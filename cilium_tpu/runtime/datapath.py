@@ -313,6 +313,10 @@ class JITDatapath(DatapathBackend):
         self._pack_lock = threading.Lock()
         self._wire_pool_cap = max(4, self.config.pipeline_inflight + 2)
         self._wire_pool: Dict[Tuple[int, int], list] = {}
+        # poolable buffers currently checked out with in-flight batches
+        # (the resource ledger's wire_pool occupancy; a lazily-filling
+        # pool makes cap-minus-free overstate wildly at startup)
+        self._wire_out = 0
         # batches since the last v6/wide-slot batch: the place() narrowing
         # only fires after a clean run, so steady v6 traffic can never
         # reset-flap the wire shape across regens
@@ -350,6 +354,17 @@ class JITDatapath(DatapathBackend):
             "patch_scatter_errors": 0,    # failed scatters self-healed by
                                           # a full verdict re-upload
         }
+        # device-memory ledger (ROADMAP item 6 groundwork / ISSUE 13): the
+        # live-placement half of the HBM truth the offline verifier's
+        # memory_analysis() budgets describe. Bytes per placed tensor
+        # group, re-accounted on every place/place_patch (reading .nbytes
+        # off a dozen device arrays — no transfers), CT at construction/
+        # restore, the wire pool on demand. hbm_ledger() is the export.
+        self._hbm_lock = threading.Lock()
+        self._hbm_groups: Dict[str, int] = {}
+        self._hbm_places = 0
+        self._hbm_patches = 0
+        self._account_ct_hbm()
         self._scatter_fn = None            # jitted donated row scatter
         # overlapped CT GC (kernels/conntrack.ct_sweep_chunk): cursor into
         # the slot space + the previous tick's un-materialized device
@@ -416,18 +431,92 @@ class JITDatapath(DatapathBackend):
             if reset:
                 self.pack_stats["wire_flag_resets"] += 1
 
+    # -- HBM ledger (ISSUE 13: the live half of the verifier's offline
+    # memory_analysis() budgets; ROADMAP item 6's hardware-truth landing
+    # zone) -------------------------------------------------------------------
+    @staticmethod
+    def _hbm_group(name: str) -> str:
+        """Placed-tensor name → ledger group. The groups mirror how an
+        operator reasons about device memory: the verdict image (the thing
+        place_patch scatters), the LPM tries (the IPv6-at-scale risk), and
+        the remaining policy planes."""
+        if name == "verdict":
+            return "verdict"
+        if name.startswith("lpm"):
+            return "tries"
+        return "policy"
+
+    def _account_placed(self, placed: Dict, patched: bool) -> None:
+        groups = {"verdict": 0, "tries": 0, "policy": 0}
+        for k, v in placed.items():
+            groups[self._hbm_group(k)] += int(getattr(v, "nbytes", 0))
+        with self._hbm_lock:
+            self._hbm_groups.update(groups)
+            if patched:
+                self._hbm_patches += 1
+            else:
+                self._hbm_places += 1
+
+    def _account_ct_hbm(self) -> None:
+        n = sum(int(getattr(v, "nbytes", 0)) for v in self._ct.values())
+        with self._hbm_lock:
+            self._hbm_groups["ct"] = n
+
+    def hbm_ledger(self) -> Dict[str, Any]:
+        """Bytes per placed tensor group, live: verdict image / LPM tries /
+        policy planes / CT table (device-resident) plus the pooled wire
+        staging buffers (host-pinned; flagged so the two kinds are never
+        summed into one misleading number). Re-accounted per
+        place/place_patch — this is the number ``--max-hbm-bytes`` budgets
+        and the resource ledger's ``hbm`` row cite."""
+        with self._pack_lock:
+            wire = sum(b.nbytes for pool in self._wire_pool.values()
+                       for b in pool)
+            wire_keys = len(self._wire_pool)
+        with self._hbm_lock:
+            groups = dict(self._hbm_groups)
+            places, patches = self._hbm_places, self._hbm_patches
+        device_total = sum(groups.values())
+        groups["wire_pool"] = wire
+        return {
+            "groups": groups,
+            "device_bytes": device_total,
+            "host_pool_bytes": wire,
+            "wire_pool_keys": wire_keys,
+            "places_total": places,
+            "patches_total": patches,
+        }
+
+    def wire_pool_stats(self) -> Dict[str, int]:
+        """Pool occupancy for the resource ledger: buffers currently
+        checked out (in flight with a dispatched batch — counted at
+        checkout/release, since cap-minus-free overstates on a lazily
+        filled pool) against the pool's total slots across active
+        (rows, words) keys."""
+        with self._pack_lock:
+            keys = max(1, len(self._wire_pool))
+            free = sum(len(p) for p in self._wire_pool.values())
+            out = self._wire_out
+        cap = self._wire_pool_cap * keys
+        return {"capacity": max(cap, out), "free": free,
+                "in_flight": out, "keys": keys}
+
     def place(self, snap: PolicySnapshot) -> Dict:
         jnp = self._jnp
         self._maybe_reset_wire_flags(snap)
         if not self._sharded:
-            return PlacedTensors(
+            placed = PlacedTensors(
                 {k: jnp.asarray(v) for k, v in snap.tensors().items()})
+            self._account_placed(placed, patched=False)
+            return placed
         import jax
         from cilium_tpu.parallel.mesh import pad_snapshot_tensors
         tensors = pad_snapshot_tensors(snap.tensors(), self.n_rule_shards)
-        return PlacedTensors({k: jax.device_put(
+        placed = PlacedTensors({k: jax.device_put(
             v, self._verdict_sharding if k == "verdict"
             else self._repl_sharding) for k, v in tensors.items()})
+        self._account_placed(placed, patched=False)
+        return placed
 
     def _put_tensor(self, name, v):
         if not self._sharded:
@@ -560,6 +649,7 @@ class JITDatapath(DatapathBackend):
                 self.patch_stats["patch_full"] += 1
         else:
             self.patch_stats["patch_full"] += 1
+        self._account_placed(new_placed, patched=True)
         return new_placed
 
     def classify(self, placed, snap, batch, now):
@@ -628,17 +718,23 @@ class JITDatapath(DatapathBackend):
             else:
                 self.pack_stats[
                     f"pack_fallback_{fallback_reason}"] += 1
-        if use_l7:
-            wire, path_dict = pack_batch_l7dict(
-                b, path_words=l7_path_words, min_rows=l7_min_rows,
-                force_full=use_wide, out=wire_buf)
-            with self._pack_lock:           # dict geometry stays grow-only
-                self._l7_dict_rows = max(self._l7_dict_rows,
-                                         path_dict.shape[0])
-        elif not use_wide:
-            wire = pack_batch_v4(b, out=wire_buf)
-        else:
-            wire = pack_batch(b, l7=False, out=wire_buf)
+        try:
+            if use_l7:
+                wire, path_dict = pack_batch_l7dict(
+                    b, path_words=l7_path_words, min_rows=l7_min_rows,
+                    force_full=use_wide, out=wire_buf)
+                with self._pack_lock:       # dict geometry stays grow-only
+                    self._l7_dict_rows = max(self._l7_dict_rows,
+                                             path_dict.shape[0])
+            elif not use_wide:
+                wire = pack_batch_v4(b, out=wire_buf)
+            else:
+                wire = pack_batch(b, l7=False, out=wire_buf)
+        except BaseException:
+            # the checkout already counted this buffer in flight; a pack
+            # that dies here never reaches a finalize to release it
+            self._wire_buf_shed(wire_key)
+            raise
         return wire, path_dict, wire_key, wire_buf
 
     @staticmethod
@@ -671,38 +767,48 @@ class JITDatapath(DatapathBackend):
             b = self._columnar(batch)
             wire, path_dict, wire_key, wire_buf = self._pack_wire(
                 b, snap, pooled=True, fallback_reason="shape")
-        with tracer.span(trace_id, "datapath.transfer",
-                         bytes=int(wire.nbytes)):
-            # chaos points: a wedged/failed host→device link (hang mode is
-            # what the pipeline watchdog drill stalls on), and the CT
-            # insert phase of this dispatch (a trip rejects the batch —
-            # tickets fail closed, FIFO intact — the ddos-smoke drill)
-            FAULTS.fire("datapath.transfer")
-            FAULTS.fire("ct.insert")
-            if path_dict is not None:
-                dev_batch = (jnp.asarray(wire),
-                             self._upload_path_dict(path_dict))
-            else:
-                dev_batch = jnp.asarray(wire)
-            with self._ct_lock:
-                self._check_placed(placed)
-                # a PlacedTensors handle is a dict SUBCLASS (not a
-                # registered pytree): hand jit the plain-dict view
-                out, new_ct, counters = self._classify(
-                    dict(placed), self._ct, dev_batch, jnp.uint32(now),
-                    jnp.int32(snap.world_index))
-                self._ct = new_ct
+        try:
+            with tracer.span(trace_id, "datapath.transfer",
+                             bytes=int(wire.nbytes)):
+                # chaos points: a wedged/failed host→device link (hang mode
+                # is what the pipeline watchdog drill stalls on), and the CT
+                # insert phase of this dispatch (a trip rejects the batch —
+                # tickets fail closed, FIFO intact — the ddos-smoke drill)
+                FAULTS.fire("datapath.transfer")
+                FAULTS.fire("ct.insert")
+                if path_dict is not None:
+                    dev_batch = (jnp.asarray(wire),
+                                 self._upload_path_dict(path_dict))
+                else:
+                    dev_batch = jnp.asarray(wire)
+                with self._ct_lock:
+                    self._check_placed(placed)
+                    # a PlacedTensors handle is a dict SUBCLASS (not a
+                    # registered pytree): hand jit the plain-dict view
+                    out, new_ct, counters = self._classify(
+                        dict(placed), self._ct, dev_batch, jnp.uint32(now),
+                        jnp.int32(snap.world_index))
+                    self._ct = new_ct
+        except BaseException:
+            self._wire_buf_shed(wire_key)    # finalize will never run
+            raise
 
         def finalize():
             # the ``fused`` tag attributes compute time to the executor
             # that produced it (Pallas megakernels vs the jnp reference) —
             # the per-kernel split itself lives in bench.py --kernels,
             # since stages inside one jit are not separately timeable
-            with tracer.span(trace_id, "datapath.compute",
-                             fused=int(self._fused)):
-                out_np = {k: np.asarray(v) for k, v in out.items()}
-                counters_np = {k: np.asarray(v)
-                               for k, v in counters.items()}
+            try:
+                with tracer.span(trace_id, "datapath.compute",
+                                 fused=int(self._fused)):
+                    out_np = {k: np.asarray(v) for k, v in out.items()}
+                    counters_np = {k: np.asarray(v)
+                                   for k, v in counters.items()}
+            except BaseException:
+                # a failed materialization (device error) never releases:
+                # shed the checkout count, the buffer goes to the GC
+                self._wire_buf_shed(wire_key)
+                raise
             if wire_key is not None:
                 # the device is provably done with this batch (out_np is
                 # materialized): the wire buffer is safe to reuse now —
@@ -720,6 +826,7 @@ class JITDatapath(DatapathBackend):
         pooling every distinct size ever seen would grow without bound."""
         if rows & (rows - 1):
             return None
+        self._wire_out += 1
         pool = self._wire_pool.get((rows, words))
         if pool:
             return pool.pop()
@@ -728,9 +835,21 @@ class JITDatapath(DatapathBackend):
     def _wire_buf_release(self, key: Tuple[int, int],
                           buf: np.ndarray) -> None:
         with self._pack_lock:
+            self._wire_out = max(0, self._wire_out - 1)
             pool = self._wire_pool.setdefault(key, [])
             if len(pool) < self._wire_pool_cap:
                 pool.append(buf)
+
+    def _wire_buf_shed(self, wire_key) -> None:
+        """A dispatch died between checkout and finalize (fault trip,
+        transfer failure): the buffer itself sheds to the GC — it may be
+        aliased by an aborted transfer, never re-pool it — but the
+        in-flight count must come back down or the wire_pool ledger row
+        reports phantom occupancy forever."""
+        if wire_key is None:
+            return
+        with self._pack_lock:
+            self._wire_out = max(0, self._wire_out - 1)
 
     def _upload_path_dict(self, path_dict: np.ndarray):
         """Device copy of the L7 path dict, cached by content: serving
@@ -823,30 +942,38 @@ class JITDatapath(DatapathBackend):
                     b, snap, pooled=pre,
                     fallback_reason="shape" if pre else "steered")
                 nbytes = int(wire.nbytes)
-        with tracer.span(trace_id, "datapath.transfer", bytes=nbytes,
-                         shards=self.n_flow_shards):
-            FAULTS.fire("datapath.transfer")
-            FAULTS.fire("ct.insert")
-            if dict_batch is not None:
-                dev_batch = dict_batch       # the jit shards the columns
-            elif path_dict is not None:
-                dev_batch = (jax.device_put(wire, self._batch_sharding),
-                             self._upload_path_dict(path_dict))
-            else:
-                dev_batch = jax.device_put(wire, self._batch_sharding)
-            with self._ct_lock:
-                self._check_placed(placed)
-                out, new_ct, counters = self._classify(
-                    dict(placed), self._ct, dev_batch, jnp.uint32(now),
-                    jnp.int32(snap.world_index))
-                self._ct = new_ct
+        try:
+            with tracer.span(trace_id, "datapath.transfer", bytes=nbytes,
+                             shards=self.n_flow_shards):
+                FAULTS.fire("datapath.transfer")
+                FAULTS.fire("ct.insert")
+                if dict_batch is not None:
+                    dev_batch = dict_batch   # the jit shards the columns
+                elif path_dict is not None:
+                    dev_batch = (jax.device_put(wire, self._batch_sharding),
+                                 self._upload_path_dict(path_dict))
+                else:
+                    dev_batch = jax.device_put(wire, self._batch_sharding)
+                with self._ct_lock:
+                    self._check_placed(placed)
+                    out, new_ct, counters = self._classify(
+                        dict(placed), self._ct, dev_batch, jnp.uint32(now),
+                        jnp.int32(snap.world_index))
+                    self._ct = new_ct
+        except BaseException:
+            self._wire_buf_shed(wire_key)    # finalize will never run
+            raise
 
         def finalize():
-            with tracer.span(trace_id, "datapath.compute",
-                             fused=int(self._fused)):
-                out_np = {k: np.asarray(v) for k, v in out.items()}
-                counters_np = {k: np.asarray(v)
-                               for k, v in counters.items()}
+            try:
+                with tracer.span(trace_id, "datapath.compute",
+                                 fused=int(self._fused)):
+                    out_np = {k: np.asarray(v) for k, v in out.items()}
+                    counters_np = {k: np.asarray(v)
+                                   for k, v in counters.items()}
+            except BaseException:
+                self._wire_buf_shed(wire_key)  # failed materialization
+                raise
             if wire_key is not None:
                 self._wire_buf_release(wire_key, wire_buf)
             if scatter is not None:
@@ -973,6 +1100,7 @@ class JITDatapath(DatapathBackend):
                             for k, v in arrays.items()}
             else:
                 self._ct = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self._account_ct_hbm()
 
 
 class FakeDatapath(DatapathBackend):

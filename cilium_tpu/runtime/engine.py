@@ -36,6 +36,7 @@ from cilium_tpu.model.services import ServiceRegistry
 from cilium_tpu.observe.audit import ShadowAuditor
 from cilium_tpu.observe.blackbox import FlightRecorder
 from cilium_tpu.observe.flowmetrics import FlowMetrics
+from cilium_tpu.observe.pressure import LADDER_EXCLUDE, ResourceLedger
 from cilium_tpu.observe.trace import TRACER
 from cilium_tpu.policy.repository import PolicyContext, Repository
 from cilium_tpu.policy.selectorcache import SelectorCache
@@ -134,6 +135,23 @@ class Engine:
             n_shards=getattr(self.datapath, "pipeline_shards", 1),
             metrics=self.metrics,
             on_mismatch=self._on_parity_mismatch)
+        # resource pressure ledger (observe/pressure.py; ISSUE 13): every
+        # bounded structure registers (capacity, occupancy, high_water)
+        # here; the resource-ledger controller polls, the labeled
+        # resource_* families + /v1/resources + `cilium-tpu top` export,
+        # health() folds pressure in as RESOURCE_PRESSURE, and the
+        # overload ladder takes the worst non-CT pressure as its fourth
+        # latch. Forecast events narrate to the flight recorder.
+        self.ledger = ResourceLedger(
+            metrics=self.metrics,
+            window=self.config.resource_eta_window,
+            warn=self.config.resource_pressure_warn,
+            crit=self.config.resource_pressure_crit,
+            eta_warn_s=self.config.resource_eta_warn_s,
+            event_sink=self.blackbox.record_event)
+        self._last_update_stats = None   # incremental UpdateStats (budgets)
+        self._hbm_budget = None          # attached verifier budget_doc
+        self._register_resources()
         self.controllers = ControllerManager()
 
         self._lock = threading.RLock()
@@ -350,6 +368,9 @@ class Engine:
                 self.metrics.inc_counter("regen_incremental_total")
                 self.metrics.set_gauge("regen_last_rows_patched",
                                        stats.rows_recomputed)
+                # the PR 9 budget headroom the resource ledger samples
+                # (patch_budget / ident_growth rows)
+                self._last_update_stats = stats
             else:
                 logging.getLogger("cilium_tpu.engine").debug(
                     "incremental fallback: %s", self._inc.last_fallback)
@@ -960,6 +981,8 @@ class Engine:
                 shed_low=cfg.overload_shed_rate_low,
                 ct_high=cfg.ct_pressure_high,
                 ct_low=cfg.ct_pressure_low,
+                resource_high=cfg.overload_resource_high,
+                resource_low=cfg.overload_resource_low,
                 up_ticks=cfg.overload_up_ticks,
                 down_ticks=cfg.overload_down_ticks)
         pl = self._pipeline
@@ -984,7 +1007,12 @@ class Engine:
         self._overload_shed_prev = shed_now
         self._overload_shed_t = t
         ct_occ = float(self.metrics.gauges.get("ct_occupancy", 0.0))
-        state, changed = self._overload.observe(queue_frac, rate, ct_occ)
+        # the ledger's fourth latch: worst NON-CT failure-class pressure
+        # (CT and the admission queue are already the ladder's own
+        # signals; graceful-degradation pools report pressure 0 anyway)
+        res_p = self.ledger.max_pressure(exclude=LADDER_EXCLUDE)
+        state, changed = self._overload.observe(queue_frac, rate, ct_occ,
+                                                resource_pressure=res_p)
         if pl is not None:
             pl.set_overload_state(state)
         fd = self._feeder
@@ -1000,12 +1028,182 @@ class Engine:
                 "overload", state=name,
                 queue_frac=round(queue_frac, 4),
                 shed_rate=round(rate, 2),
-                ct_occupancy=round(ct_occ, 4))
+                ct_occupancy=round(ct_occ, 4),
+                resource_pressure=round(res_p, 4))
         return self._overload.status()
 
     def overload_status(self) -> Optional[Dict]:
         ov = self._overload
         return ov.status() if ov is not None else None
+
+    # -- resource pressure ledger (observe/pressure.py; ISSUE 13) --------------
+    # Provider contract: each returns {resource: (capacity, occupancy)} or
+    # (capacity, occupancy, pressure) — the 3-tuple hands through a
+    # canonical pressure fraction. Structures that degrade GRACEFULLY at
+    # full occupancy (drop-oldest rings, LRU caches, backpressure pools)
+    # report explicit pressure 0.0: occupancy/high-water stay visible but
+    # "full" is their steady state, not a capacity failure — only
+    # structures whose exhaustion sheds/fails (CT, queue, shard segments,
+    # budgets) carry failure-signal pressure.
+    def _register_resources(self) -> None:
+        self.ledger.register("ct", self._res_ct)
+        self.ledger.register("pipeline", self._res_pipeline)
+        self.ledger.register("feeder", self._res_feeder)
+        self.ledger.register("compile", self._res_compile)
+        self.ledger.register("observe", self._res_observe)
+        self.ledger.register("datapath", self._res_datapath)
+
+    def _res_ct(self) -> Dict:
+        # the ct_occupancy gauge IS the canonical fraction: hand it
+        # through verbatim so the resource row and the gauge can never
+        # disagree (the cfg6 bench gates on exact equality)
+        occ = float(self.metrics.gauges.get("ct_occupancy", 0.0))
+        cap = self.config.ct_capacity
+        return {"ct_table": (cap, occ * cap, occ)}
+
+    def _res_pipeline(self) -> Dict:
+        pl = self._pipeline
+        if pl is None:
+            return {}
+        ps = pl.occupancy_stats()
+        out = {
+            "admission_queue": (ps["queue_max"], ps["queue_depth"]),
+            # staging slots backpressure by design (acquire blocks):
+            # informational
+            "staging_slots": (ps["staging_slots"],
+                              ps["staging_slots"] - ps["staging_free"],
+                              0.0),
+            # capacity is the ring's REAL aggregate (n_shards * seg_cap
+            # when sharded — headroom makes that exceed max_bucket, and
+            # staged rows legitimately pass max_bucket before a segment
+            # fills; max_bucket as capacity would read >100%)
+            "staging_ring": (ps["stage_rows"], ps["staged_rows"], 0.0),
+        }
+        if ps.get("n_shards", 1) > 1:
+            # the binding sharded constraint: ONE overfull segment sheds
+            # the whole submission (steer_overflow) — a real capacity
+            # failure, unlike the flush-on-full aggregate ring
+            out["staging_segment_peak"] = (
+                ps["shard_capacity"], max(ps["shard_fill"], default=0))
+        return out
+
+    def _res_feeder(self) -> Dict:
+        fd = self._feeder
+        if fd is None:
+            return {}
+        st = fd.stats()
+        # pool exhaustion = FIFO backpressure on the oldest ticket (by
+        # design) — informational occupancy, not failure pressure
+        return {"feeder_pool": (self.config.ingest_pool_batches,
+                                st.get("pending", 0), 0.0)}
+
+    def _res_compile(self) -> Dict:
+        from cilium_tpu.policy.mapstate import overlay_stats
+        cfg = self.config
+        st = self._last_update_stats
+        # PR 9 patch budgets: all informational (explicit pressure 0.0).
+        # At-budget means delta cycles fall back to full uploads/rebuilds
+        # — a perf cliff, commanded and graceful, never traffic loss. And
+        # delta_rows/new_identities are the LAST cycle's consumption, not
+        # a standing occupancy: letting them carry failure pressure would
+        # pin health/the ladder's resource latch on an idle engine until
+        # the next (unrelated) update happened to be smaller.
+        out = {
+            "patch_budget": (cfg.patch_delta_rows,
+                             st.delta_rows if st is not None else 0, 0.0),
+            "ident_growth": (512 if self._inc is None
+                             else self._inc.IDENT_GROWTH_MAX,
+                             st.new_identities if st is not None else 0,
+                             0.0),
+        }
+        inc = self._inc
+        if inc is not None:
+            out["patch_overlay"] = (cfg.patch_rebase_rows,
+                                    len(inc._overlay), 0.0)  # noqa: SLF001
+        # mapstate overlay folds at budget BY DESIGN (one amortized
+        # O(entries) flatten) — occupancy/high-water visibility only; a
+        # pre-fold dirty count past the budget must not read as an
+        # exhaustion (it would strict-freeze the recorder on commanded
+        # behavior)
+        ovs = overlay_stats()
+        out["mapstate_overlay"] = (ovs["fold_budget"], ovs["last_dirty"],
+                                   0.0)
+        return out
+
+    def _res_observe(self) -> Dict:
+        ts = self.tracer.stats()
+        bs = self.blackbox.stats()
+        aud = self.auditor.stats()
+        return {
+            # drop-oldest rings wrap by design: informational (their loss
+            # accounting lives in spans_dropped_total / follow gaps)
+            "trace_ring": (ts["capacity"], ts["spans_in_ring"], 0.0),
+            "flowlog_ring": (self.flowlog.capacity, len(self.flowlog),
+                             0.0),
+            "blackbox_events": (bs["events_capacity"],
+                                bs["events_in_ring"], 0.0),
+            # the audit capture pool saturating means the replay loop is
+            # lagging live capture — real pressure (skips are counted,
+            # but sustained skipping blinds the parity contract)
+            "audit_pool": (aud["pool_batches"], aud["pending"]),
+        }
+
+    def _res_datapath(self) -> Dict:
+        out: Dict = {}
+        dp = self.datapath
+        ws = getattr(dp, "wire_pool_stats", None)
+        if ws is not None:
+            s = ws()
+            # occupancy = buffers checked out with in-flight batches;
+            # pool misses allocate (shed to GC) — informational
+            out["wire_pool"] = (s["capacity"], s["in_flight"], 0.0)
+        hl = getattr(dp, "hbm_ledger", None)
+        if hl is not None and self.config.max_hbm_bytes > 0:
+            out["hbm"] = (self.config.max_hbm_bytes,
+                          hl()["device_bytes"])
+        import sys as _sys
+        cls_mod = _sys.modules.get("cilium_tpu.kernels.classify")
+        if cls_mod is not None:
+            cs = cls_mod.fn_cache_stats()
+            # LRU: full-with-evictions is a retrace cost, not a failure
+            out["classify_fn_cache"] = (cs["cap"], cs["size"], 0.0)
+        return out
+
+    def resource_step(self, now: Optional[float] = None) -> Dict:
+        """One ledger sweep (the ``resource-ledger`` controller body;
+        directly callable from benches/tests with a logical clock for
+        deterministic ETA math). Exports the labeled resource_* gauge
+        families and fires forecast events; returns the full report."""
+        FAULTS.fire("resource.poll")
+        return self.ledger.poll(now)
+
+    def resources(self) -> Dict:
+        """The ``GET /v1/resources`` document: the ledger's READ side (the
+        last controller sweep) plus the device-memory ledger. Deliberately
+        side-effect-free — a scrape or a tight ``top --interval`` loop
+        must not fire the resource.poll fault point, skew the ETA windows'
+        sampling cadence, or be the thing that fires a freeze event; the
+        ``resource-ledger`` controller owns sampling."""
+        report = self.ledger.report()
+        report["hbm"] = self.hbm_status()
+        return report
+
+    def hbm_status(self) -> Dict:
+        """Live HBM ledger (JIT backends; None on the jax-free fake) plus
+        the attached offline verifier budget report — the two surfaces
+        ISSUE 13 requires to cite the same numbers."""
+        hl = getattr(self.datapath, "hbm_ledger", None)
+        return {
+            "ledger": hl() if hl is not None else None,
+            "max_hbm_bytes": self.config.max_hbm_bytes or None,
+            "verifier": self._hbm_budget,
+        }
+
+    def note_verifier_budget(self, doc: Dict) -> None:
+        """Attach an offline ``compile/verifier.budget_doc`` summary so
+        status/bench surfaces cite the same HBM numbers the ``verify
+        --max-hbm-bytes`` gate judged."""
+        self._hbm_budget = doc
 
     # -- multi-host sync (runtime/clustermesh.py) -------------------------------
     def attach_mesh(self, store_dir: Optional[str] = None,
@@ -1072,6 +1270,16 @@ class Engine:
             self.controllers.update(
                 "overload", self.overload_step,
                 interval=self.config.overload_interval_s)
+        if self.config.resource_ledger_enabled:
+            # the resource pressure ledger (observe/pressure.py): one
+            # sweep of every registered bounded structure per interval —
+            # labeled gauge export, high-water, time-to-exhaustion
+            # forecasts into the flight recorder. Supervised like every
+            # controller: a crashing poll backs off and the last exported
+            # pressure stands.
+            self.controllers.update(
+                "resource-ledger", self.resource_step,
+                interval=self.config.resource_interval_s)
         if self.config.autotune_enabled:
             # the closed loop (observe/autotune.py): queue-wait + fill
             # histograms → bounded flush_ms / bucket-floor adjustments
@@ -1194,6 +1402,23 @@ class Engine:
             from cilium_tpu.pipeline.guard import OVERLOAD_OVERLOAD
             if ost["level"] >= OVERLOAD_OVERLOAD \
                     and doc["state"] == C.HEALTH_OK:
+                doc["state"] = C.HEALTH_DEGRADED
+        rs = self.ledger.status()
+        if rs["pressured"]:
+            # RESOURCE_PRESSURE detail (ISSUE 13): some bounded structure
+            # is past its warn fraction — the which-runs-out-first answer,
+            # with the soonest exhaustion forecast attached. Warn-level
+            # pressure is an attention state, not a failure; CRITICAL
+            # pressure (past resource_pressure_crit) degrades health like
+            # a mesh/pipeline fault would
+            doc["resources"] = {
+                "detail": C.RESOURCE_PRESSURE,
+                "pressured": rs["pressured"],
+                "max_pressure": rs["max_pressure"],
+                "min_eta": rs["min_eta"],
+                "critical": rs["critical"],
+            }
+            if rs["critical"] and doc["state"] == C.HEALTH_OK:
                 doc["state"] = C.HEALTH_DEGRADED
         if pl is not None:
             # outside the engine lock: pipeline stats take the pipeline
@@ -1326,6 +1551,23 @@ class Engine:
                         "classify_fn_cache_evictions_total", d)
                     self._pack_stats_seen["fn_cache:evictions"] = \
                         cs["evictions"]
+        # trace-ring drop accounting (ISSUE 13): the tracer's drop-oldest
+        # overwrites + full wraps as real counters (same delta-fold as the
+        # pack stats — the tracer's own totals are process-lifetime ints)
+        ts = self.tracer.stats()
+        with self._pack_fold_lock:
+            for key, name in (("spans_dropped_total",
+                               "trace_spans_dropped_total"),
+                              ("ring_wraps", "trace_ring_wraps_total")):
+                d = ts[key] - self._pack_stats_seen.get(f"trace:{key}", 0)
+                if d > 0:
+                    self.metrics.inc_counter(name, d)
+                    self._pack_stats_seen[f"trace:{key}"] = ts[key]
+                elif d < 0:
+                    # the process-wide tracer was reset (tests/operator
+                    # re-arm): re-baseline so future losses keep counting
+                    # instead of waiting out the old watermark
+                    self._pack_stats_seen[f"trace:{key}"] = ts[key]
         # feeder liveness/occupancy as first-class gauge families (the
         # monotone feeder_*_total counters are already incremented live by
         # the feeder itself; these are the fields that existed only in
@@ -1375,6 +1617,11 @@ class Engine:
             # clean shutdown: queued submissions are classified, not dropped
             pl.close(timeout=30.0)
         self.controllers.stop_all()
+        # deregister every ledger resource (drops the whole exported
+        # resource_* label family per resource): a stopped engine must not
+        # leave frozen pressure series behind for the next engine sharing
+        # this process's textfile/scrape surface
+        self.ledger.deregister_all()
         self._regen_trigger.cancel()
         if self._api is not None:
             self._api.stop()

@@ -89,6 +89,11 @@ POINTS: Dict[str, str] = {
                      "the live verdicts) — simulates a datapath parity bug "
                      "so chaos drills prove the auditor detects, health "
                      "degrades, and a flight-recorder bundle freezes",
+    "resource.poll": "one resource-pressure ledger sweep "
+                     "(Engine.resource_step): trips exercise the "
+                     "resource-ledger controller's supervised backoff — "
+                     "serving and the last exported pressure gauges must "
+                     "be untouched by a wedged/failing poll",
     "ct.gc": "one tick of the overlapped device-side CT GC "
              "(Engine.sweep_step): trips exercise the ct-gc controller's "
              "supervised backoff — classify traffic and CT correctness "

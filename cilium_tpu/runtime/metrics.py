@@ -186,6 +186,17 @@ class Metrics:
         with self._lock:
             self.gauges[name] = value
 
+    def set_gauges(self, values: Dict[str, float],
+                   drop: Sequence[str] = ()) -> None:
+        """Write a batch of gauges (and drop departed ones) under ONE lock
+        acquisition — the resource ledger exports five families per
+        resource per poll, and per-gauge locking was measurable against
+        the <2% polling-overhead attestation."""
+        with self._lock:
+            self.gauges.update(values)
+            for name in drop:
+                self.gauges.pop(name, None)
+
     def drop_gauge(self, name: str) -> None:
         """Remove a labeled gauge whose subject is gone (e.g. a departed
         clustermesh peer) — a frozen last value would keep exporting a

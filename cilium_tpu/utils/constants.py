@@ -210,6 +210,9 @@ HEALTH_OK = "OK"
 HEALTH_DEGRADED = "DEGRADED"
 HEALTH_STALE = "STALE"
 HEALTH_STATES = (HEALTH_OK, HEALTH_DEGRADED, HEALTH_STALE)
+# health() detail key: a registered bounded structure is past its warn
+# pressure fraction (observe/pressure.py resource ledger, ISSUE 13)
+RESOURCE_PRESSURE = "RESOURCE_PRESSURE"
 # Clustermesh staleness detail (runtime/clustermesh.status()): the store
 # has been unreachable past the staleness budget — remote state still
 # serves last-good (never fail closed on established remote flows), but
