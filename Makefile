@@ -10,7 +10,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        ddos-smoke cluster-smoke pressure-smoke shim bench clean
+        ddos-smoke cluster-smoke pressure-smoke rss-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -146,7 +146,26 @@ pressure-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_pressure.py -q -m "not slow"
 	$(PYTEST_ENV) python -m pytest tests/test_pressure.py -q -m slow
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke
+# Device-side RSS gate (parallel/exchange.py + rss_mode="device"): the
+# tier-1 device-RSS subset — ring-primitive units, exchange-vs-steered
+# bit-identity through a saturating flood (CT_FULL + tail-evict order),
+# the device parity suite vs the steered mesh and the oracle, the
+# skewed/alternating/cfg6-storm arrival patterns with zero sheds, the
+# degraded steer-revision fence, the rss_exchange ledger row + swept
+# steer gauges, and the auditor at sampling 1.0 — plus the slow-marked
+# 10k-row all-one-shard skewed soak host steering cannot survive
+# shed-free, and a steered-vs-unsteered `bench.py --rss device` A/B
+# round (cfg1: the policy/LPM-weighted workload where the steered
+# path's skew collapse is visible) whose rss_gate exits 4 on failure —
+# skew immunity + zero device sheds always; the absolute fps
+# comparison arms on TPU (CPU-unmeasurable by construction, like the
+# --kernels fused gate).
+rss-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_rss.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_rss.py -q -m slow
+	$(PYTEST_ENV) python bench.py --pipeline --config 1 --shards 4 --rss device --preset smoke > /tmp/cilium_tpu_rss_gate.json
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -188,6 +188,13 @@ def _compare_artifacts(new_doc: dict, old_path: str,
         "baseline_rev": (old_doc.get("provenance") or {}).get("git_rev"),
         "factor": factor,
         "checked": checked,
+        # steered and unsteered sharded artifacts are deliberately
+        # comparable (same metric surface; the span-attribution contract
+        # lives in each artifact's own schema_check, not here) — the
+        # annotation makes a cross-mode diff visible in the artifact
+        **({"rss": {"old": old_doc.get("rss", "host"),
+                    "new": new_doc.get("rss", "host")}}
+           if (new_doc.get("rss") or old_doc.get("rss")) else {}),
         "failed": bool(regressions),
         **({"regressions": regressions} if regressions else {}),
     }
@@ -1957,9 +1964,50 @@ def run_bench(config: int, preset: str, batch: int, batches: int,
     }
 
 
+def _bench_bucket(cfg, batch: int, shards: int, mode: str) -> int:
+    """Dispatch-shape parity between the RSS modes: a steered flush
+    always ships the FULL n_shards*seg_cap layout (= batch * headroom
+    rows, mostly valid under balanced traffic), so the unsteered ring
+    sizes its bucket to the same aggregate rows — equal rows-per-dispatch
+    and equal staging memory; anything else compares dispatch-overhead
+    amortization, not steering."""
+    if shards > 1 and mode == "device":
+        return batch * cfg.pipeline_shard_headroom
+    return batch
+
+
+def _bench_pipeline(dispatch_fn, met, cfg, batch: int, shards: int,
+                    mode: str, shard_fn=None):
+    """The bench's serving Pipeline — ONE construction shared by the
+    primary pipeline_bench measurement and the rss A/B, so the two sides
+    of the steered-vs-unsteered comparison can never drift into
+    differently configured pipelines. min_bucket == max_bucket: every
+    coalesced dispatch is the one device-optimal shape (no trace
+    proliferation); stall_timeout wide — a cold-shape XLA compile or a
+    tunnel burst must not look like a device stall to the watchdog on
+    this rig."""
+    from cilium_tpu.pipeline import Pipeline
+    sharded = shards > 1
+    steered = sharded and mode == "host"
+    bucket = _bench_bucket(cfg, batch, shards, mode)
+    return Pipeline(dispatch_fn, metrics=met, max_bucket=bucket,
+                    min_bucket=bucket,
+                    queue_batches=max(64, cfg.pipeline_queue_batches),
+                    admission="block", block_timeout_s=60.0,
+                    flush_ms=cfg.pipeline_flush_ms,
+                    inflight=cfg.pipeline_inflight,
+                    stall_timeout_s=300.0,
+                    n_shards=shards if steered else 1,
+                    shard_fn=shard_fn if steered else None,
+                    shard_headroom=cfg.pipeline_shard_headroom,
+                    mesh_shards=shards if sharded else 0,
+                    rss_mode=mode if sharded else "host")
+
+
 def pipeline_bench(config: int, preset: str, batch: int, batches: int,
                    windows: int = 3, verbose: bool = False,
-                   trace: bool = False, shards: int = 1):
+                   trace: bool = False, shards: int = 1,
+                   rss: str = "host"):
     """Serial vs pipelined ingestion on one config, through the real
     ``DatapathBackend`` boundary (JITDatapath behind the Pipeline
     scheduler), over the same ingest stream: the shim's rx polls deliver
@@ -1982,6 +2030,24 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     pipelined through the pre-steered staging ring. Requires ``shards``
     visible devices; tracing auto-enables so the artifact always carries
     the steer/scatter span split.
+
+    ``rss="device"`` (with ``shards`` > 1) measures the device-side RSS
+    path instead — arrival-order staging, the in-kernel ring ppermute CT
+    exchange, no host steer/scatter anywhere (the schema check asserts
+    those spans are ABSENT) — and appends a steered-vs-unsteered A/B
+    (``rss_ab``): balanced traffic plus a skewed stream whose flows all
+    hash to one CT shard, where the device path's win is structural
+    (one segment serializes the steered mesh) rather than incremental.
+    The ``rss_gate`` (exit 4) always arms the structural half — skew
+    immunity (the steered path must degrade under skew by
+    CILIUM_TPU_BENCH_RSS_SKEW_IMMUNITY_MIN more than the device path)
+    plus zero device sheds — and arms the absolute throughput
+    comparison (balanced within CILIUM_TPU_BENCH_RSS_AB_SLACK, strict
+    win on skew) on TPU only: the CPU virtual mesh serializes the
+    chips onto a couple of host cores, which inflates the exchange's
+    per-chip CT redundancy ~n× in a way real hardware never sees (the
+    same rig-unmeasurable-by-construction split as the --kernels
+    fused gate).
     """
     from cilium_tpu.observe.trace import TRACER
     from cilium_tpu.pipeline import Pipeline
@@ -1990,6 +2056,7 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     from cilium_tpu.runtime.metrics import Metrics
 
     sharded = shards > 1
+    device_rss = sharded and rss == "device"
     trace = trace or sharded
     if trace:
         # --trace: sample every submission so the per-stage summary in the
@@ -2003,7 +2070,8 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     compile_s = time.time() - t0
     cfg = DaemonConfig(ct_capacity=snap.ct_config.capacity,
                        probe_depth=snap.ct_config.probe_depth,
-                       v4_only=v4_only, batch_size=batch, n_shards=shards)
+                       v4_only=v4_only, batch_size=batch, n_shards=shards,
+                       rss_mode=rss if sharded else "host")
     dp = JITDatapath(cfg)
     placed = dp.place(snap)
     rng = np.random.default_rng(7)
@@ -2031,26 +2099,17 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
         return flow_shard_of(b, shards, lb=lb)
 
     def make_pipeline(met):
+        mode = "device" if device_rss else "host"
+        steered = sharded and not device_rss
+
         def dispatch_fn(b, n, steer_rev=None):
             # fixed snapshot for the whole run: a pre-steered bucket can
             # never be stale, whatever revision it was steered under
             fin = dp.classify_async(placed, snap, b, n,
-                                    pre_steered=sharded)
+                                    pre_steered=steered)
             return lambda: fin()[0]
-        # min_bucket == batch: every coalesced dispatch is the one
-        # device-optimal shape (no trace proliferation)
-        # stall_timeout: wide — a cold-shape XLA compile or a tunnel burst
-        # must not look like a device stall to the watchdog on this rig
-        return Pipeline(dispatch_fn, metrics=met, max_bucket=batch,
-                        min_bucket=batch,
-                        queue_batches=max(64, cfg.pipeline_queue_batches),
-                        admission="block", block_timeout_s=60.0,
-                        flush_ms=cfg.pipeline_flush_ms,
-                        inflight=cfg.pipeline_inflight,
-                        stall_timeout_s=300.0,
-                        n_shards=shards if sharded else 1,
-                        shard_fn=shard_fn if sharded else None,
-                        shard_headroom=cfg.pipeline_shard_headroom)
+        return _bench_pipeline(dispatch_fn, met, cfg, batch, shards, mode,
+                               shard_fn=shard_fn)
 
     met = Metrics()
     pl = make_pipeline(met)        # long-lived, like a serving daemon's
@@ -2134,22 +2193,205 @@ def pipeline_bench(config: int, preset: str, batch: int, batches: int,
     if sharded:
         doc.update({
             "shards": shards,
+            "rss": "device" if device_rss else "host",
             "aggregate_flows_per_sec": round(pipe_med, 1),
             "per_chip_flows_per_sec": round(pipe_med / shards, 1),
             "vs_baseline": round(pipe_med / shards / PER_CHIP_TARGET, 4),
             "pack_stats": pack_pipe,
             "pack_stats_total": dict(dp.pack_stats),
-            "shard_fill": stats.get("shard_fill"),
-            "shard_rows_total": stats.get("shard_rows_total"),
-            "shard_capacity": stats.get("shard_capacity"),
+            **({"shard_fill": stats.get("shard_fill"),
+                "shard_rows_total": stats.get("shard_rows_total"),
+                "shard_capacity": stats.get("shard_capacity")}
+               if not device_rss else
+               {"rss_exchange": dp.rss_exchange_stats()}),
         })
         spans = doc.get("trace_spans", {})
         doc["steer_split"] = {k: spans[k] for k in
                               ("pipeline.steer", "pipeline.stage_write",
                                "datapath.pack", "datapath.steer")
                               if k in spans}
+        if device_rss:
+            doc["rss_ab"] = _rss_ab(
+                pipe_med, chunks, gen, snap, lb, cfg, batch, batches,
+                chunk, shards, now, _med, verbose=verbose)
+            import jax
+            doc["rss_gate"] = _rss_gate(doc["rss_ab"],
+                                        jax.devices()[0].platform)
         doc.update(_sharded_schema_check(doc, shards))
     return doc
+
+
+def _rss_ab(device_balanced_fps, chunks, gen, snap, lb, cfg, batch,
+            batches, chunk, shards, now, med, verbose=False):
+    """The steered-vs-unsteered A/B the device-RSS artifact carries: the
+    same balanced chunk stream through a HOST-steered mesh, plus a skewed
+    stream — every flow hashing to ONE CT shard (rejection-sampled
+    through the real steer hash) — through both modes. On skewed traffic
+    the device path's win is structural: classify work spreads by arrival
+    while host steering serializes the whole mesh behind one segment."""
+    import time as _time
+    from cilium_tpu.parallel.mesh import flow_shard_of
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.metrics import Metrics
+
+    def skewed_stream(n_chunks):
+        rng = np.random.default_rng(1123)
+        need = n_chunks * chunk
+        cols, got = None, 0
+        while got < need:
+            full = gen(rng, batch)
+            sh = flow_shard_of(full, shards, lb=lb)
+            keep = (sh == 0) & np.asarray(full["valid"], dtype=bool)
+            if cols is None:
+                cols = {k: [] for k in full}
+            for k, v in full.items():
+                cols[k].append(np.asarray(v)[keep])
+            got += int(keep.sum())
+        cat = {k: np.concatenate(v)[:need] for k, v in cols.items()}
+        return [{k: v[j:j + chunk] for k, v in cat.items()}
+                for j in range(0, need, chunk)]
+
+    def build(mode):
+        steered = mode == "host"
+        cfg_m = DaemonConfig(ct_capacity=snap.ct_config.capacity,
+                             probe_depth=snap.ct_config.probe_depth,
+                             v4_only=cfg.v4_only,
+                             batch_size=_bench_bucket(cfg, batch, shards,
+                                                      mode),
+                             n_shards=shards, rss_mode=mode)
+        dp_m = JITDatapath(cfg_m)
+        placed_m = dp_m.place(snap)
+
+        def dispatch_fn(b, n, steer_rev=None):
+            fin = dp_m.classify_async(placed_m, snap, b, n,
+                                      pre_steered=steered)
+            return lambda: fin()[0]
+        return _bench_pipeline(
+            dispatch_fn, Metrics(), cfg, batch, shards, mode,
+            shard_fn=lambda b: flow_shard_of(b, shards, lb=lb))
+
+    def one_pass(pl_m, chunk_list):
+        for i in range(batches * (batch // chunk)):
+            now[0] += 1
+            pl_m.submit(chunk_list[i % len(chunk_list)], now=now[0])
+        assert pl_m.drain(timeout=600), "rss A/B drain timed out"
+
+    def measure_pair(chunk_list, n_windows=3):
+        """Both modes over the same stream, windows INTERLEAVED with
+        alternating order — rig drift (CPU freq, background load, CT
+        aging) hits both modes instead of whichever ran second."""
+        pls = {m: build(m) for m in ("host", "device")}
+        for pl_m in pls.values():
+            one_pass(pl_m, chunk_list)       # warm: traces + pools
+        fps = {"host": [], "device": []}
+        for w in range(n_windows):
+            order = ("host", "device") if w % 2 == 0 else ("device", "host")
+            for m in order:
+                t1 = _time.time()
+                one_pass(pls[m], chunk_list)
+                fps[m].append(batches * batch / (_time.time() - t1))
+        stats_pair = {m: pls[m].stats() for m in pls}
+        for pl_m in pls.values():
+            pl_m.close(timeout=30)
+        return {m: med(v) for m, v in fps.items()}, stats_pair
+
+    skewed = skewed_stream(max(4, min(8, len(chunks))))
+    bal, _bal_st = measure_pair(chunks)
+    sk, sk_st = measure_pair(skewed)
+    if verbose:
+        print(f"# rss A/B: balanced host={bal['host'] / 1e6:.2f} "
+              f"device={bal['device'] / 1e6:.2f} Mfl/s "
+              f"(primary device run: {device_balanced_fps / 1e6:.2f}); "
+              f"skewed host={sk['host'] / 1e6:.2f} "
+              f"device={sk['device'] / 1e6:.2f}", file=sys.stderr)
+    return {
+        "balanced": {
+            "host_flows_per_sec": round(bal["host"], 1),
+            "device_flows_per_sec": round(bal["device"], 1),
+            "device_over_host": round(
+                bal["device"] / max(bal["host"], 1e-9), 3),
+        },
+        "skewed": {
+            "host_flows_per_sec": round(sk["host"], 1),
+            "device_flows_per_sec": round(sk["device"], 1),
+            "device_over_host": round(
+                sk["device"] / max(sk["host"], 1e-9), 3),
+            # the failure mode the device path retires: a steered mesh
+            # under all-one-shard traffic sheds (steer_overflow) or
+            # serializes — either shows here
+            "host_shed_total": sk_st["host"].get("shed_total", 0),
+            "device_shed_total": sk_st["device"].get("shed_total", 0),
+        },
+    }
+
+
+#: balanced-traffic slack for the rss_gate's TPU-armed absolute half:
+#: device mode must hold >= host/slack on balanced traffic and win
+#: strictly on skew
+RSS_AB_SLACK = float(os.environ.get("CILIUM_TPU_BENCH_RSS_AB_SLACK", "1.1"))
+#: the always-armed structural gate: under the all-one-shard stream the
+#: steered path must degrade at least this factor MORE than the device
+#: path does (host_bal/host_sk vs dev_bal/dev_sk) — the skewed-flood
+#: imbalance failure mode the exchange exists to retire, measurable on
+#: any rig because it is a ratio of ratios
+RSS_SKEW_IMMUNITY_MIN = float(os.environ.get(
+    "CILIUM_TPU_BENCH_RSS_SKEW_IMMUNITY_MIN", "1.3"))
+
+
+def _rss_gate(ab: dict, platform: str) -> dict:
+    """Two-tier gate, mirroring the --kernels fused gate's platform
+    split: the ABSOLUTE throughput comparison (device >= host/slack on
+    balanced, strictly > on skew) arms only on TPU — on the CPU smoke
+    rig the virtual mesh serializes every chip's work onto a couple of
+    host cores, so the exchange's per-chip CT redundancy (the price of
+    shedless skew tolerance with static shapes) inflates ~n_shards×
+    in wall clock in a way n real chips never see; gating fps there
+    measures the rig, not the code. The STRUCTURAL half — skew
+    immunity + zero device sheds — is a ratio of ratios and always
+    arms: steered throughput must collapse under the all-one-shard
+    stream while the device path holds, or the whole point of the
+    mode is missing."""
+    reasons = []
+    bal, sk = ab["balanced"], ab["skewed"]
+    eps = 1e-9
+    host_deg = bal["host_flows_per_sec"] / max(sk["host_flows_per_sec"],
+                                               eps)
+    dev_deg = bal["device_flows_per_sec"] / max(
+        sk["device_flows_per_sec"], eps)
+    immunity = host_deg / max(dev_deg, eps)
+    if immunity < RSS_SKEW_IMMUNITY_MIN:
+        reasons.append(
+            f"skew immunity {immunity:.2f} < {RSS_SKEW_IMMUNITY_MIN}: "
+            f"steered degrades {host_deg:.2f}x under skew vs device "
+            f"{dev_deg:.2f}x — the structural win is missing")
+    if sk["device_shed_total"]:
+        reasons.append(
+            f"skewed: device path shed {sk['device_shed_total']} "
+            "submissions (no shed class should exist without steering)")
+    throughput_armed = platform == "tpu"
+    if throughput_armed:
+        if bal["device_over_host"] < 1.0 / RSS_AB_SLACK:
+            reasons.append(
+                f"balanced: device {bal['device_flows_per_sec']} < host "
+                f"{bal['host_flows_per_sec']}/{RSS_AB_SLACK}")
+        if sk["device_over_host"] <= 1.0:
+            reasons.append(
+                f"skewed: device {sk['device_flows_per_sec']} <= host "
+                f"{sk['host_flows_per_sec']}")
+    return {
+        "failed": bool(reasons),
+        "slack": RSS_AB_SLACK,
+        "skew_immunity_min": RSS_SKEW_IMMUNITY_MIN,
+        "host_skew_degradation": round(host_deg, 3),
+        "device_skew_degradation": round(dev_deg, 3),
+        "skew_immunity_ratio": round(immunity, 3),
+        # False = this artifact came from a rig whose absolute fps
+        # comparison is unmeasurable by construction (see docstring);
+        # the ROADMAP item-6 v5e pass arms it
+        "throughput_gate_armed": throughput_armed,
+        **({"reasons": reasons} if reasons else {}),
+    }
 
 
 #: max tolerated per-shard traffic skew, expressed as a multiple of the
@@ -2168,12 +2410,32 @@ def _sharded_schema_check(doc: dict, shards: int) -> dict:
     traffic within SHARD_SKEW_LIMIT of the mean (`shard_rows_total` is
     counted independently at ingest, so a steering bug that parks the work
     on one chip fails the artifact loudly instead of hiding inside an
-    aggregate headline)."""
+    aggregate headline).
+
+    Device-RSS artifacts (``doc["rss"] == "device"``) invert the span
+    contract: the host ``pipeline.steer``/``datapath.steer`` spans must
+    be ABSENT (their presence means the host tax the mode exists to
+    delete is still being paid), and the per-shard balance check does
+    not apply (rows never group by shard on the host — that is the
+    point). This is what keeps steered and unsteered artifacts
+    comparable under ``--compare`` without tripping the
+    span-attribution gate."""
     problems = []
+    rss = doc.get("rss", "host")
     if doc.get("aggregate_flows_per_sec", 0) <= 0 \
             or doc.get("per_chip_flows_per_sec", 0) <= 0:
         problems.append("missing per-chip/aggregate throughput")
-    if "pipeline.steer" not in doc.get("steer_split", {}) \
+    spans = {}
+    spans.update(doc.get("stage_split") or {})
+    spans.update(doc.get("steer_split") or {})
+    spans.update(doc.get("trace_spans") or {})
+    if rss == "device":
+        for sp in ("pipeline.steer", "datapath.steer"):
+            if sp in spans:
+                problems.append(
+                    f"{sp} span present in a device-RSS artifact "
+                    "(host steering still running)")
+    elif "pipeline.steer" not in doc.get("steer_split", {}) \
             and "pipeline.steer" not in doc.get("stage_split", {}):
         problems.append("steer span missing from the stage split")
     pack = doc.get("pack_stats") or {}
@@ -2182,7 +2444,9 @@ def _sharded_schema_check(doc: dict, shards: int) -> dict:
             f'pack_fallback{{reason="steered"}} = '
             f'{pack["pack_fallback_steered"]} on the steered path')
     rows = doc.get("shard_rows_total")
-    if not rows or len(rows) != shards:
+    if rss == "device":
+        pass            # no host-side per-shard grouping exists to judge
+    elif not rows or len(rows) != shards:
         problems.append("shard_rows_total missing from pipeline stats")
     elif sum(rows) >= 64 * shards:       # enough traffic to judge balance
         total = sum(rows)
@@ -2252,7 +2516,7 @@ def _single_chip_regression_gate(spans: dict, fps: float) -> dict:
 
 def ingest_bench(preset: str, batch: int, n_frames: int = 0,
                  verbose: bool = False, shards: int = 1,
-                 observer: bool = False):
+                 observer: bool = False, rss: str = "host"):
     """Shim→verdict end-to-end over the mock rings: frames are injected
     NIC-side into the rx ring, the async feeder (shim/feeder.py) harvests
     on a budget into reusable poll buffers, the pipeline coalesces and
@@ -2284,7 +2548,8 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
                        # armed in BOTH windows (the flowlog predates this
                        # bench; what's measured is the observe machinery)
                        flowlog_mode="all" if observer else "none",
-                       n_shards=shards)
+                       n_shards=shards,
+                       rss_mode=rss if shards > 1 else "host")
     eng = Engine(cfg, datapath=JITDatapath(cfg))
     eng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
     # a non-trivial ruleset so classification isn't a no-op: cfg1-style
@@ -2564,13 +2829,15 @@ def ingest_bench(preset: str, batch: int, n_frames: int = 0,
     if shards > 1:
         doc.update({
             "shards": shards,
+            "rss": rss,
             "aggregate_frames_per_sec": round(fps, 1),
             "per_chip_frames_per_sec": round(fps / shards, 1),
             "aggregate_flows_per_sec": round(fps, 1),
             "per_chip_flows_per_sec": round(fps / shards, 1),
-            "shard_fill": pstats.get("shard_fill"),
-            "shard_rows_total": pstats.get("shard_rows_total"),
-            "shard_capacity": pstats.get("shard_capacity"),
+            **({"shard_fill": pstats.get("shard_fill"),
+                "shard_rows_total": pstats.get("shard_rows_total"),
+                "shard_capacity": pstats.get("shard_capacity")}
+               if rss != "device" else {}),
         })
         doc.update(_sharded_schema_check(doc, shards))
     else:
@@ -2923,6 +3190,14 @@ def main(argv=None):
                          "the steer/scatter span split")
     ap.add_argument("--rule-shards", type=int, default=1,
                     help="verdict-row shards (rule-space mesh axis)")
+    ap.add_argument("--rss", default="host", choices=["host", "device"],
+                    help="with --shards > 1: where flow→shard resolution "
+                         "runs — 'host' = the steered staging path, "
+                         "'device' = the in-kernel ring ppermute CT "
+                         "exchange (no host steer/scatter; with "
+                         "--pipeline the artifact carries a "
+                         "steered-vs-unsteered A/B incl. a skewed-"
+                         "traffic case, gated by rss_gate)")
     ap.add_argument("--windows", type=int, default=5,
                     help="timing windows per mode (median+IQR reported)")
     ap.add_argument("--profile", default="", metavar="DIR",
@@ -2978,13 +3253,19 @@ def main(argv=None):
 
     def _finish(result) -> None:
         """Shared artifact tail: provenance stamp, optional --compare gate
-        (exit 4 on regression past the factor), one JSON line."""
+        (exit 4 on regression past the factor), one JSON line. Device-RSS
+        A/B deltas ride into the provenance block so a later --compare
+        against this artifact carries the steered-vs-unsteered evidence."""
         result["provenance"] = _provenance(argv)
+        if result.get("rss_ab"):
+            result["provenance"]["rss_ab"] = result["rss_ab"]
         rc = 0
         if args.compare:
             result["compare"] = _compare_artifacts(result, args.compare)
             if result["compare"]["failed"]:
                 rc = 4
+        if result.get("rss_gate", {}).get("failed"):
+            rc = 4
         _progress["headline"] = result
         print(json.dumps(result))
         if rc:
@@ -3059,14 +3340,14 @@ def main(argv=None):
     if args.ingest:
         result = ingest_bench(preset, batch, n_frames=args.frames,
                               verbose=args.verbose, shards=args.shards,
-                              observer=args.observer)
+                              observer=args.observer, rss=args.rss)
         _finish(result)
         return
     if args.pipeline:
         result = pipeline_bench(args.config, preset, batch, batches,
                                 windows=max(3, args.windows - 2),
                                 verbose=args.verbose, trace=args.trace,
-                                shards=args.shards)
+                                shards=args.shards, rss=args.rss)
         _finish(result)
         return
     result = run_bench(args.config, preset, batch, batches,

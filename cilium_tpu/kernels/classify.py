@@ -75,6 +75,33 @@ def compose_verdict(decision, enforced, cell_redirect, l7_fail,
     return allow, reason, status, redirect
 
 
+def interior_pre_core(tensors, ep_slot, direction, id_idx, proto,
+                      dport, http_method, http_path, rule_axis=None):
+    """The CT-independent half of the classify interior: policy ladder +
+    L7 token match → (decision, enforced, cell_redirect, l7_fail, mrule).
+    Nothing here depends on the CT probe result — only the final
+    ``compose_verdict`` does — which is exactly what lets the device-RSS
+    exchange path (parallel/exchange.py) run this BEFORE the ring
+    ``ppermute`` CT hop and compose after the replies land, while the
+    steered/serial paths keep calling it through
+    :func:`classify_interior_core` unchanged. One source of the ladder/L7
+    semantics either way."""
+    decision, l7_cell, enforced, mrule = policy_lookup_batch(
+        tensors, ep_slot, direction, id_idx, proto, dport,
+        rule_axis=rule_axis)
+    # L7-lite: the CURRENT policy cell's rules apply to every packet with
+    # tokens — new and established flows alike (the per-request proxy
+    # semantics; CT entries carry no L7 state, so policy swaps need no
+    # remap)
+    has_tokens = (http_method != C.HTTP_METHOD_ANY) \
+        | (http_path != 0).any(axis=-1)
+    cell_redirect = decision == C.VERDICT_REDIRECT
+    set_to_check = jnp.where(cell_redirect, l7_cell, 0)
+    l7_ok = l7_match_batch(tensors, set_to_check, http_method, http_path)
+    l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
+    return decision, enforced, cell_redirect, l7_fail, mrule
+
+
 def classify_interior_core(tensors, ep_slot, direction, id_idx, proto,
                            dport, http_method, http_path, est, reply, valid,
                            rule_axis=None):
@@ -94,24 +121,172 @@ def classify_interior_core(tensors, ep_slot, direction, id_idx, proto,
     (kernels/policy.py): the resolved cell coordinate where a ladder
     actually ran (valid row, enforced direction), -1 otherwise — identical
     across the jnp reference, the fused kernel and the oracle."""
-    decision, l7_cell, enforced, mrule = policy_lookup_batch(
-        tensors, ep_slot, direction, id_idx, proto, dport,
-        rule_axis=rule_axis)
-    # L7-lite: the CURRENT policy cell's rules apply to every packet with
-    # tokens — new and established flows alike (the per-request proxy
-    # semantics; CT entries carry no L7 state, so policy swaps need no
-    # remap)
-    has_tokens = (http_method != C.HTTP_METHOD_ANY) \
-        | (http_path != 0).any(axis=-1)
-    cell_redirect = decision == C.VERDICT_REDIRECT
-    set_to_check = jnp.where(cell_redirect, l7_cell, 0)
-    l7_ok = l7_match_batch(tensors, set_to_check, http_method, http_path)
-    l7_fail = has_tokens & (set_to_check > 0) & ~l7_ok
+    decision, enforced, cell_redirect, l7_fail, mrule = interior_pre_core(
+        tensors, ep_slot, direction, id_idx, proto, dport, http_method,
+        http_path, rule_axis=rule_axis)
     allow, reason, status, redirect = compose_verdict(
         decision, enforced, cell_redirect, l7_fail, est, reply, valid)
     matched_rule = jnp.where(valid & enforced, mrule,
                              jnp.int32(-1)).astype(jnp.int32)
     return allow, reason, status, redirect, matched_rule
+
+
+def classify_pre_ct(tensors, batch, world_index, *, v4_only: bool = False,
+                    rule_axis=None, lb_probe_depth: int = 8, plan=None,
+                    fused_interpret: bool = False,
+                    split_interior: bool = False):
+    """Steps 0-1 of the datapath (service LB → ipcache LPM) plus the CT
+    key derivation, as one pure function shared by :func:`classify_step`
+    and the device-RSS exchange path (parallel/exchange.py) — the single
+    source of everything that happens BEFORE the conntrack stage.
+
+    Returns a dict:
+      ``batch``  — the post-DNAT column dict (dst/dport rewritten),
+      ``valid``  — post-LB validity (``valid & ~no_backend``),
+      ``svc`` / ``rev_nat`` / ``no_backend`` — the LB columns,
+      ``id_idx`` / ``remote_identity`` / ``lpm_prefix`` — the LPM result
+      + provenance (masked by the ORIGINAL valid, like classify_step),
+      ``fwd_keys`` / ``rev_keys`` — the post-DNAT CT key pair.
+
+    ``split_interior=True`` additionally runs :func:`interior_pre_core`
+    (ladder + L7, no compose) and adds ``decision``/``enforced``/
+    ``cell_redirect``/``l7_fail``/``mrule`` — the form the exchange path
+    needs, since est/reply only exist after the ppermute hop. ``plan``
+    (kernels/fused.fuse_plan) routes the LPM walk through the Pallas
+    kernel when eligible, exactly like classify_step."""
+    valid0 = batch["valid"]
+    direction = batch["direction"]
+    # 0. service LB (bpf/lib/lb.h analog): frontend match → Maglev backend
+    # → DNAT. Everything downstream (LPM, CT, policy) sees the translated
+    # tuple, exactly like the upstream from-container path.
+    has_lb = "lb_tab_keys" in tensors
+    if has_lb:
+        new_dst, new_dport, rev_nat, no_backend = lb_step(
+            tensors, batch, probe_depth=lb_probe_depth)
+        svc = rev_nat > 0
+        batch = dict(batch)
+        batch["dst"] = new_dst
+        batch["dport"] = new_dport
+        valid = valid0 & ~no_backend
+    else:
+        n = valid0.shape[0]
+        rev_nat = jnp.zeros((n,), dtype=jnp.int32)
+        svc = jnp.zeros((n,), dtype=bool)
+        no_backend = jnp.zeros((n,), dtype=bool)
+        valid = valid0
+
+    # 1. ipcache LPM: remote = dst on egress, src on ingress. The walk
+    # resolves the identity index AND the winning prefix provenance
+    # ((slot << 8) | plen, -1 on miss) in the same register chain
+    remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
+                             batch["dst"], batch["src"])
+    if plan is not None and plan.lpm:
+        from cilium_tpu.kernels import fused as fk
+        id_idx, pfx_meta = fk.lpm_lookup_fused(
+            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
+            batch["is_v6"], world_index, v4_only=v4_only,
+            interpret=fused_interpret)
+    else:
+        id_idx, pfx_meta = lpm_lookup_prov_batch(
+            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
+            batch["is_v6"], default_index=world_index, v4_only=v4_only)
+    remote_identity = tensors["identity_ids"][id_idx].astype(jnp.uint32)
+    # provenance masking follows the same truth the columns they explain
+    # use: lpm_prefix for every row that was valid at ingest (NO_SERVICE
+    # rows keep their VIP-resolved identity AND its prefix), -1 otherwise
+    lpm_prefix = jnp.where(valid0, pfx_meta,
+                           jnp.int32(-1)).astype(jnp.int32)
+
+    # CT key pair (post-DNAT): the reverse key is a word permutation of
+    # the forward key — normalized once, derived twice
+    fwd_keys, rev_keys = ctk.ct_key_words_pair(batch)
+
+    pre = {
+        "batch": batch, "valid": valid, "svc": svc, "rev_nat": rev_nat,
+        "no_backend": no_backend, "id_idx": id_idx,
+        "remote_identity": remote_identity, "lpm_prefix": lpm_prefix,
+        "fwd_keys": fwd_keys, "rev_keys": rev_keys,
+    }
+    if split_interior:
+        decision, enforced, cell_redirect, l7_fail, mrule = \
+            interior_pre_core(
+                tensors, batch["ep_slot"], direction, id_idx,
+                batch["proto"], batch["dport"], batch["http_method"],
+                batch["http_path"], rule_axis=rule_axis)
+        pre.update(decision=decision, enforced=enforced,
+                   cell_redirect=cell_redirect, l7_fail=l7_fail,
+                   mrule=mrule)
+    return pre
+
+
+def ct_update_stage(ct, fwd_keys, proto, tcp_flags, hit, hit_slot, reply,
+                    new, allow, rev_nat_vals, now,
+                    probe_depth: int = PROBE_DEPTH):
+    """Step 6 (+ the 6b batch-start rev-NAT read) of the datapath: the CT
+    insert-when-full + aggregate apply, shared verbatim by
+    :func:`classify_step` (local rows) and the device-RSS exchange's
+    owner-side stage (gathered rows) — the single source of the CT
+    mutation semantics, including the tail-evict victim order. Slots this
+    batch probe-hit are protected from eviction (snapshot semantics), and
+    a flow whose window stays exhausted even after evicting fails CLOSED
+    (``ct_full``, the CT_FULL drop the caller composes in).
+
+    → (new_ct, ct_full [N] bool, entry_rnat [N] int32 — the batch-start
+    ``rev_nat`` read at each row's hit slot, garbage where ``~hit`` and
+    discarded by the caller's reply mask — and n_evicted uint32)."""
+    want_insert = new & allow
+    cap = ct["expiry"].shape[0]
+    protected = jnp.zeros((cap,), dtype=bool).at[
+        jnp.where(hit, hit_slot, cap)].set(True, mode="drop")
+    new_keys, new_created, zero_mask, slot_new, fail, n_evicted = \
+        ctk.ct_insert_new(ct, fwd_keys, want_insert, now, probe_depth,
+                          evict=True, protected=protected)
+    ct_full = fail                       # fail ⊆ want_insert ⊆ new & allow
+    allow = allow & ~ct_full
+    slot = jnp.where(hit, hit_slot, slot_new)
+    contrib = allow & (jnp.where(hit, True, slot_new >= 0))
+    new_ct = ctk.ct_apply(ct, {"proto": proto, "tcp_flags": tcp_flags},
+                          slot, reply, contrib, now,
+                          new_keys=new_keys, new_created=new_created,
+                          zero_mask=zero_mask, rev_nat_vals=rev_nat_vals)
+    # 6b read half (lb4_rev_nat analog): the CT entry's stable rev-NAT id
+    # as-of the batch start — reads the PRE-apply table, exactly like
+    # classify_step always did
+    slot_safe = jnp.where(hit_slot >= 0, hit_slot, 0)
+    entry_rnat = ct["rev_nat"][slot_safe].astype(jnp.int32)
+    return new_ct, ct_full, entry_rnat, n_evicted
+
+
+def resolve_rev_nat(tensors, entry_rnat, reply, src, sport):
+    """Step 6b resolution: a reply on a service flow carries the CT
+    entry's stable rev-NAT id → rewrite src back to the VIP. Ids whose
+    service is gone resolve to an invalid row → no rewrite (fail closed;
+    never another service's VIP). Shared by classify_step and the
+    exchange path — the replicated ``lb_rnat_*`` tensors make this a
+    purely local lookup once ``entry_rnat`` rode the reply buffer home."""
+    if "lb_rnat_valid" not in tensors:
+        n = reply.shape[0]
+        rnat = jnp.zeros((n,), dtype=bool)
+        return rnat, src, sport.astype(jnp.int32)
+    n_rnat = tensors["lb_rnat_valid"].shape[0]
+    rid = entry_rnat - 1
+    known = (rid >= 0) & (rid < n_rnat)
+    rid_safe = jnp.where(known, rid, 0)
+    rnat = reply & known & tensors["lb_rnat_valid"][rid_safe]
+    rnat_src = jnp.where(rnat[:, None], tensors["lb_rnat_addr"][rid_safe],
+                         src)
+    rnat_sport = jnp.where(rnat, tensors["lb_rnat_port"][rid_safe],
+                           sport).astype(jnp.int32)
+    return rnat, rnat_src, rnat_sport
+
+
+def tally_by_reason_dir(reason, direction, counted):
+    """Step 7: the per-reason × direction counter tensor (metricsmap
+    analog) — one scatter-add, shared by every classify executor."""
+    bin_idx = reason * 2 + direction
+    scat = jnp.where(counted, bin_idx, N_REASON_BINS * 2)
+    return jnp.zeros((N_REASON_BINS * 2,), dtype=jnp.uint32).at[scat].add(
+        jnp.uint32(1), mode="drop")
 
 
 def classify_step(tensors, ct, batch, now, world_index=0, *,
@@ -142,8 +317,6 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     choice is a per-shape trace-time constant, never data-dependent.
     ``fused_interpret`` runs those kernels in the Pallas interpreter (the
     CPU-CI bit-identity mode)."""
-    valid = batch["valid"]
-    direction = batch["direction"]
     if fused:
         from cilium_tpu.kernels import fused as fk
         plan = fk.fuse_plan(tensors, ct, v4_only=v4_only,
@@ -151,50 +324,23 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     else:
         plan = None
 
-    # 0. service LB (bpf/lib/lb.h analog): frontend match → Maglev backend →
-    # DNAT. Everything downstream (LPM, CT, policy) sees the translated
-    # tuple, exactly like the upstream from-container path (LB before
-    # policy). ``no_backend`` drops below.
-    has_lb = "lb_tab_keys" in tensors
-    if has_lb:
-        new_dst, new_dport, rev_nat, no_backend = lb_step(
-            tensors, batch, probe_depth=lb_probe_depth)
-        svc = rev_nat > 0
-        batch = dict(batch)
-        batch["dst"] = new_dst
-        batch["dport"] = new_dport
-        valid = valid & ~no_backend
-    else:
-        n = valid.shape[0]
-        rev_nat = jnp.zeros((n,), dtype=jnp.int32)
-        svc = jnp.zeros((n,), dtype=bool)
-        no_backend = jnp.zeros((n,), dtype=bool)
+    # 0-1. service LB + ipcache LPM + CT key derivation — the shared
+    # pre-CT stage (classify_pre_ct; also the device-RSS exchange's local
+    # prologue). The interior splits (ladder/L7 before compose) exactly
+    # when the fused policy kernel is NOT taking the whole stage.
+    split = plan is None or not plan.policy
+    pre = classify_pre_ct(tensors, batch, world_index, v4_only=v4_only,
+                          rule_axis=rule_axis, lb_probe_depth=lb_probe_depth,
+                          plan=plan, fused_interpret=fused_interpret,
+                          split_interior=split)
+    batch = pre["batch"]
+    valid = pre["valid"]
+    direction = batch["direction"]
+    no_backend = pre["no_backend"]
+    svc = pre["svc"]
+    fwd_keys, rev_keys = pre["fwd_keys"], pre["rev_keys"]
 
-    # 1. ipcache LPM: remote = dst on egress, src on ingress. The walk
-    # resolves the identity index AND the winning prefix provenance
-    # ((slot << 8) | plen, -1 on miss) in the same register chain — the
-    # lpm_prefix out column below is the evidence for "why this identity"
-    remote_words = jnp.where((direction == C.DIR_EGRESS)[:, None],
-                             batch["dst"], batch["src"])
-    if plan is not None and plan.lpm:
-        id_idx, pfx_meta = fk.lpm_lookup_fused(
-            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
-            batch["is_v6"], world_index, v4_only=v4_only,
-            interpret=fused_interpret)
-    else:
-        id_idx, pfx_meta = lpm_lookup_prov_batch(
-            tensors["lpm_v4"], tensors["lpm_v6"], remote_words,
-            batch["is_v6"], default_index=world_index, v4_only=v4_only)
-    remote_identity = tensors["identity_ids"][id_idx].astype(jnp.uint32)
-    # provenance masking follows the same truth the columns they explain
-    # use: lpm_prefix for every row that was valid at ingest (NO_SERVICE
-    # rows keep their VIP-resolved identity AND its prefix), -1 otherwise
-    lpm_prefix = jnp.where(batch["valid"], pfx_meta,
-                           jnp.int32(-1)).astype(jnp.int32)
-
-    # 2. conntrack probe (batch-start snapshot); the reverse key is a word
-    # permutation of the forward key — normalized once, derived twice
-    fwd_keys, rev_keys = ctk.ct_key_words_pair(batch)
+    # 2. conntrack probe (batch-start snapshot)
     if plan is not None and plan.ct:
         fwd_slot, rev_slot = fk.ct_probe_pair_fused(
             ct, fwd_keys, rev_keys, now, probe_depth,
@@ -209,76 +355,47 @@ def classify_step(tensors, ct, batch, now, world_index=0, *,
     hit_slot = jnp.where(est, fwd_slot, jnp.where(reply, rev_slot, 0))
 
     # 3-5. policy ladder + L7 token match + verdict composition (the fused
-    # interior; see classify_interior_core)
+    # interior, or the split jnp core composed here — same semantics, see
+    # classify_interior_core)
     if plan is not None and plan.policy:
         allow, reason, status, redirect, matched_rule = \
             fk.policy_verdict_fused(
-                tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
-                batch["dport"], batch["http_method"], batch["http_path"],
-                est, reply, valid, interpret=fused_interpret)
+                tensors, batch["ep_slot"], direction, pre["id_idx"],
+                batch["proto"], batch["dport"], batch["http_method"],
+                batch["http_path"], est, reply, valid,
+                interpret=fused_interpret)
     else:
-        allow, reason, status, redirect, matched_rule = \
-            classify_interior_core(
-                tensors, batch["ep_slot"], direction, id_idx, batch["proto"],
-                batch["dport"], batch["http_method"], batch["http_path"],
-                est, reply, valid, rule_axis=rule_axis)
+        allow, reason, status, redirect = compose_verdict(
+            pre["decision"], pre["enforced"], pre["cell_redirect"],
+            pre["l7_fail"], est, reply, valid)
+        matched_rule = jnp.where(valid & pre["enforced"], pre["mrule"],
+                                 jnp.int32(-1)).astype(jnp.int32)
     reason = jnp.where(no_backend, int(C.DropReason.NO_SERVICE), reason)
 
-    # 6. CT insert for allowed new flows — with the insert-when-full tail
-    # eviction (kernels/conntrack docstring): slots this batch probe-hit
-    # are protected from eviction (snapshot semantics), and a flow whose
-    # window stays exhausted even after evicting fails CLOSED with the
-    # CT_FULL drop reason (an untracked flow would bypass the ladder
-    # forever once its peer replies) — then aggregate effects
-    want_insert = new & allow
-    cap = ct["expiry"].shape[0]
-    protected = jnp.zeros((cap,), dtype=bool).at[
-        jnp.where(hit, hit_slot, cap)].set(True, mode="drop")
-    new_keys, new_created, zero_mask, slot_new, fail, n_evicted = \
-        ctk.ct_insert_new(ct, fwd_keys, want_insert, now, probe_depth,
-                          evict=True, protected=protected)
-    ct_full = fail                       # fail ⊆ want_insert ⊆ new & allow
+    # 6 + 6b-read. CT insert-when-full + aggregate apply + the batch-start
+    # rev-NAT read (ct_update_stage — shared with the exchange path's
+    # owner-side stage, so the tail-evict order has one source)
+    new_ct, ct_full, entry_rnat, n_evicted = ct_update_stage(
+        ct, fwd_keys, batch["proto"], batch["tcp_flags"], hit, hit_slot,
+        reply, new, allow, pre["rev_nat"], now, probe_depth)
     allow = allow & ~ct_full
     reason = jnp.where(ct_full, int(C.DropReason.CT_FULL), reason)
-    slot = jnp.where(hit, hit_slot, slot_new)
-    contrib = allow & (jnp.where(hit, True, slot_new >= 0))
-    new_ct = ctk.ct_apply(ct, batch, slot, reply, contrib, now,
-                          new_keys=new_keys, new_created=new_created,
-                          zero_mask=zero_mask, rev_nat_vals=rev_nat)
 
-    # 6b. reply un-DNAT (lb4_rev_nat analog): a reply on a service flow
-    # carries the CT entry's stable rev-NAT id → rewrite src back to the
-    # VIP. Ids whose service is gone resolve to an invalid row → no rewrite
-    # (fail closed; never another service's VIP).
-    if has_lb:
-        slot_safe = jnp.where(hit_slot >= 0, hit_slot, 0)
-        entry_rnat = ct["rev_nat"][slot_safe].astype(jnp.int32)
-        n_rnat = tensors["lb_rnat_valid"].shape[0]
-        rid = entry_rnat - 1
-        known = (rid >= 0) & (rid < n_rnat)
-        rid_safe = jnp.where(known, rid, 0)
-        rnat = reply & known & tensors["lb_rnat_valid"][rid_safe]
-        rnat_src = jnp.where(rnat[:, None], tensors["lb_rnat_addr"][rid_safe],
-                             batch["src"])
-        rnat_sport = jnp.where(rnat, tensors["lb_rnat_port"][rid_safe],
-                               batch["sport"]).astype(jnp.int32)
-    else:
-        rnat = jnp.zeros_like(svc)
-        rnat_src = batch["src"]
-        rnat_sport = batch["sport"].astype(jnp.int32)
+    # 6b. reply un-DNAT resolution against the replicated lb_rnat_* planes
+    rnat, rnat_src, rnat_sport = resolve_rev_nat(
+        tensors, entry_rnat, reply, batch["src"], batch["sport"])
 
     # 7. counters (metricsmap analog: per reason × direction); no_backend
     # drops count under NO_SERVICE even though they are datapath-invalid
     counted = valid | no_backend
-    bin_idx = reason * 2 + direction
-    scat = jnp.where(counted, bin_idx, N_REASON_BINS * 2)
-    by_reason_dir = jnp.zeros((N_REASON_BINS * 2,), dtype=jnp.uint32).at[scat].add(
-        jnp.uint32(1), mode="drop")
+    by_reason_dir = tally_by_reason_dir(reason, direction, counted)
     counters = {
         "by_reason_dir": by_reason_dir,
-        "insert_fail": fail.sum().astype(jnp.uint32),
+        "insert_fail": ct_full.sum().astype(jnp.uint32),
         "ct_evicted": n_evicted,
     }
+    remote_identity = pre["remote_identity"]
+    lpm_prefix = pre["lpm_prefix"]
 
     out = {
         "allow": allow,

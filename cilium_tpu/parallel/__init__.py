@@ -12,12 +12,13 @@ TPU-natively):
 """
 
 from cilium_tpu.parallel.mesh import (
-    flow_shard_of, make_mesh, make_sharded_classify_fn, pad_snapshot_tensors,
-    shard_ct_arrays, steer_batch, unsteer_outputs,
+    flow_shard_of, make_mesh, make_sharded_classify_fn,
+    make_unsteered_classify_fn, pad_snapshot_tensors, shard_ct_arrays,
+    steer_batch, unsteer_outputs,
 )
 
 __all__ = [
     "flow_shard_of", "make_mesh", "make_sharded_classify_fn",
-    "pad_snapshot_tensors", "shard_ct_arrays", "steer_batch",
-    "unsteer_outputs",
+    "make_unsteered_classify_fn", "pad_snapshot_tensors",
+    "shard_ct_arrays", "steer_batch", "unsteer_outputs",
 ]

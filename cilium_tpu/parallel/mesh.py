@@ -11,10 +11,15 @@ XLA insert collectives):
   (rules axis). Everything else is embarrassingly parallel — this is the
   RSS/per-CPU-map structure of the reference datapath, on ICI.
 - CT sharding: the table's slot axis splits across 'flows'; each local table
-  is an independent power-of-two hash table. Correct flow→shard placement is
-  the HOST's job (steer_batch) — the direction-normalized hash guarantees a
-  flow's forward and reply packets reach the same shard, so device code
-  needs no cross-chip CT traffic at all.
+  is an independent power-of-two hash table. With ``rss_mode="host"``,
+  correct flow→shard placement is the HOST's job (steer_batch) — the
+  direction-normalized hash guarantees a flow's forward and reply packets
+  reach the same shard, so device code needs no cross-chip CT traffic at
+  all. With ``rss_mode="device"`` (make_unsteered_classify_fn) rows arrive
+  in plain FIFO order and the flow→shard resolution moves INTO the
+  shard_map body: a ring ``ppermute`` exchange over the 'flows' axis
+  (parallel/exchange.py) routes CT lookups/inserts to their owning shard —
+  the host steer/scatter disappears from the hot path entirely.
 """
 
 from __future__ import annotations
@@ -299,6 +304,63 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
     lets the sharded serving path pack in place into one pooled buffer
     whose per-shard segments ARE the per-chip transfers.
     """
+    from cilium_tpu.kernels.classify import classify_step
+
+    rule_axis = "rules" if mesh.shape["rules"] > 1 else None
+
+    def body(tensors, ct, batch, now, world_index):
+        return classify_step(
+            tensors, ct, batch, now, world_index,
+            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis,
+            fused=fused, fused_interpret=fused_interpret)
+
+    return _make_meshed_classify(mesh, body, donate_ct=donate_ct)
+
+
+def make_unsteered_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
+                               v4_only: bool = False, donate_ct: bool = True,
+                               fused: bool = False,
+                               fused_interpret: bool = False):
+    """shard_map'd + jitted DEVICE-RSS classify step over ``mesh``
+    ('flows','rules'): batch rows shard over 'flows' in plain ARRIVAL
+    order — no host steering, no placement semantics in the row layout —
+    and cross-shard CT lookups/inserts resolve with the ring ``ppermute``
+    exchange (parallel/exchange.py) inside the shard_map body. Outputs
+    come back in the same arrival row order (FIFO — no un-steer gather
+    anywhere), bit-identical to what the steered path computes for the
+    same rows, CT_FULL tail-evict order included (the gathered request
+    set preserves global row order, and the owner-side CT stage IS the
+    steered path's ct_update_stage).
+
+    The collective set inside the body stays bounded and documented: the
+    counter psum over 'flows' (+ the policy-cell psum over 'rules' when
+    rule-sharded) plus the 2(n-1) ring ppermute hops of the exchange.
+    ``fused`` honors the LPM and CT-probe Pallas kernels; the policy
+    stage runs the split jnp core (see classify_step_exchange). The only
+    shape contract: batch rows must divide the 'flows' axis (each chip
+    takes an equal arrival-order slice).
+
+    Accepts the same batch forms as :func:`make_sharded_classify_fn`
+    (column dict, packed wire, (wire, path_dict))."""
+    from cilium_tpu.parallel.exchange import classify_step_exchange
+
+    n_flow = mesh.shape["flows"]
+    rule_axis = "rules" if mesh.shape["rules"] > 1 else None
+
+    def body(tensors, ct, batch, now, world_index):
+        return classify_step_exchange(
+            tensors, ct, batch, now, world_index,
+            axis_name="flows", n_shards=n_flow,
+            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis,
+            fused=fused, fused_interpret=fused_interpret)
+
+    return _make_meshed_classify(mesh, body, donate_ct=donate_ct)
+
+
+def _make_meshed_classify(mesh, body, donate_ct: bool = True):
+    """The shared shard_map/jit plumbing behind both meshed classify
+    variants: spec construction, the per-(tensor-key-set, batch-kind) jit
+    cache, device-side wire unpack, and the counter psum."""
     import jax
     try:
         from jax import shard_map
@@ -311,16 +373,10 @@ def make_sharded_classify_fn(mesh, probe_depth: int = PROBE_DEPTH,
                  else "check_rep")
     from jax.sharding import PartitionSpec as P
 
-    from cilium_tpu.kernels.classify import classify_step
-
     rule_sharded = mesh.shape["rules"] > 1
-    rule_axis = "rules" if rule_sharded else None
 
     def local_fn(tensors, ct, batch, now, world_index):
-        out, new_ct, counters = classify_step(
-            tensors, ct, batch, now, world_index,
-            probe_depth=probe_depth, v4_only=v4_only, rule_axis=rule_axis,
-            fused=fused, fused_interpret=fused_interpret)
+        out, new_ct, counters = body(tensors, ct, batch, now, world_index)
         # counters are global: reduce over 'flows' only — along 'rules' the
         # batch is replicated and every shard computes identical counts
         # (summing there would multiply by the rules-axis size)

@@ -341,6 +341,8 @@ class Pipeline:
                  shard_fn: Optional[Callable] = None,
                  shard_headroom: int = 4,
                  shard_rev_fn: Optional[Callable[[], int]] = None,
+                 mesh_shards: int = 0,
+                 rss_mode: str = "host",
                  event_sink: Optional[Callable] = None):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
@@ -363,7 +365,22 @@ class Pipeline:
         if n_shards > 1 and shard_fn is None:
             raise ValueError("a sharded pipeline needs shard_fn "
                              "(per-row flow-shard ids)")
+        if rss_mode not in ("host", "device"):
+            raise ValueError(f"bad rss_mode {rss_mode!r} (host | device)")
+        if rss_mode == "device" and n_shards > 1:
+            # device RSS deletes host steering by definition: steered
+            # (per-shard-segment) staging under it would reintroduce the
+            # very scatter the ppermute exchange retires
+            raise ValueError("rss_mode='device' stages unsharded "
+                             "(n_shards must be 1; pass the mesh size via "
+                             "mesh_shards)")
         self._dispatch_fn = dispatch_fn
+        # the serving mesh behind this pipeline, for the per-mesh guard
+        # surface: with device-side RSS the staging ring is UNSHARDED
+        # (n_shards == 1 — row order carries no placement semantics) but
+        # one watchdog/breaker generation still fences mesh_shards chips
+        self._mesh_shards = mesh_shards if mesh_shards > 0 else n_shards
+        self._rss_mode = rss_mode
         # sharded staging (the software-RSS half of the multi-chip path):
         # each staging slot holds n_shards per-shard segments of seg_cap
         # rows; ingest steers rows into their segment, flush dispatches the
@@ -480,14 +497,17 @@ class Pipeline:
         self._bucket_rows = 0
         self._pub: Dict = {}             # worker-published stats snapshot
 
-        if n_shards > 1:
+        if self._mesh_shards > 1:
             # the guard runs per-mesh: one breaker/watchdog generation
             # fences ALL shards together (a wedged shard must never yield
             # half-mesh verdicts), and the gauge says how many chips one
-            # restart takes down
-            self.metrics.set_gauge("pipeline_mesh_shards", n_shards)
-            self._hb_dispatch_label = f"dispatch[mesh={n_shards}]"
-            self._hb_finalize_label = f"finalize[mesh={n_shards}]"
+            # restart takes down — true for steered AND device-RSS meshes
+            # (device mode stages unsharded but one dispatch still covers
+            # every chip)
+            self.metrics.set_gauge("pipeline_mesh_shards",
+                                   self._mesh_shards)
+            self._hb_dispatch_label = f"dispatch[mesh={self._mesh_shards}]"
+            self._hb_finalize_label = f"finalize[mesh={self._mesh_shards}]"
         else:
             self._hb_dispatch_label = "dispatch"
             self._hb_finalize_label = "finalize"
@@ -777,6 +797,8 @@ class Pipeline:
                 "queue_depth": len(self._queue),
                 "queue_max": self._queue_max,
                 "n_shards": self._n_shards,
+                "mesh_shards": self._mesh_shards,
+                "rss_mode": self._rss_mode,
                 # aggregate staging rows: n_shards * seg_cap when sharded
                 # (seg_cap carries headroom, so this exceeds max_bucket)
                 "stage_rows": self._stage_rows,
@@ -814,6 +836,11 @@ class Pipeline:
             "queue_max": self._queue_max,
             "overload_level": self._overload_level,
             "n_shards": self._n_shards,
+            # the mesh behind this pipeline + where RSS runs: with
+            # rss_mode="device" n_shards is 1 (unsharded staging) while
+            # mesh_shards still names the chips one guard fence covers
+            "mesh_shards": self._mesh_shards,
+            "rss_mode": self._rss_mode,
             **({"shard_capacity": self._seg_cap,
                 "shard_fill": pub.get("shard_fill",
                                       [0] * self._n_shards),

@@ -198,6 +198,9 @@ def status_doc(engine: "Engine") -> Dict:
         # Pallas megakernel selector state (None on jax-free backends —
         # the oracle-backed fake has no kernels to fuse)
         "fused_kernels": getattr(engine.datapath, "fused_state", None),
+        # flow→shard resolution surface (None on jax-free backends): host
+        # steering vs the device-side ppermute exchange (rss_mode)
+        "rss": getattr(engine.datapath, "rss_state", None),
         # None until the ingestion pipeline has been started
         "pipeline": engine.pipeline_stats(),
         # None until a shim feeder is attached (Engine.start_feeder)

@@ -32,6 +32,16 @@ class DaemonConfig:
     device: str = "auto"           # auto | cpu | tpu
     n_shards: int = 1              # data-parallel flow shards (mesh size)
     rule_shards: int = 1           # rule-space (verdict-row) shards
+    # flow-to-shard resolution for the sharded mesh (n_shards > 1):
+    # "host" = the classic steered path (feeder pre-binning + staging-ring
+    # scatter; a flow MUST land on its CT shard before dispatch);
+    # "device" = device-side RSS — each chip classifies whatever rows
+    # arrive on it and cross-shard CT lookups/inserts resolve with a ring
+    # ppermute exchange inside the shard_map body (parallel/exchange.py).
+    # Device mode deletes the host steer/scatter from the hot path, the
+    # steer_overflow shed class, and the skewed-flood imbalance failure
+    # mode; verdicts stay bit-identical to the steered path.
+    rss_mode: str = "host"         # host | device
     donate_ct: bool = True
     # Pallas megakernel selector for the classify interior (kernels/fused.py):
     # "auto" compiles the fused path on TPU and keeps the jnp reference
@@ -217,6 +227,9 @@ class DaemonConfig:
             raise ValueError(
                 f"bad fused_kernels mode {self.fused_kernels!r} "
                 "(auto | on | off)")
+        if self.rss_mode not in ("host", "device"):
+            raise ValueError(
+                f"bad rss_mode {self.rss_mode!r} (host | device)")
         if self.pipeline_admission not in ("block", "drop"):
             raise ValueError(
                 f"bad pipeline admission {self.pipeline_admission!r}")

@@ -249,10 +249,18 @@ class JITDatapath(DatapathBackend):
         # Pallas megakernel selector (kernels/fused.py): trace-time static,
         # so both classify fns below bake the choice into their jit keys
         self._fused, self._fused_interpret = resolve_fused(self.config)
+        # device-side RSS (rss_mode="device", parallel/exchange.py): rows
+        # arrive on chips in plain FIFO order and cross-shard CT resolves
+        # with the in-kernel ring ppermute exchange — no host steering, no
+        # pre-binning, no un-steer. Host mode keeps the classic steered
+        # path. Only meaningful on a flow-sharded mesh.
+        self._rss_device = (self.config.rss_mode == "device"
+                            and self.n_flow_shards > 1)
         if self._sharded:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from cilium_tpu.parallel.mesh import (
-                make_mesh, make_sharded_classify_fn, shard_ct_arrays)
+                make_mesh, make_sharded_classify_fn,
+                make_unsteered_classify_fn, shard_ct_arrays)
             if self.n_flow_shards & (self.n_flow_shards - 1):
                 raise ValueError("n_shards must be a power of two (each CT "
                                  "shard is a power-of-two hash table)")
@@ -267,7 +275,9 @@ class JITDatapath(DatapathBackend):
             shard_ct_arrays(ct_host, self.n_flow_shards)
             self._ct = {k: jax.device_put(v, self._ct_sharding)
                         for k, v in ct_host.items()}
-            self._classify = make_sharded_classify_fn(
+            make_fn = (make_unsteered_classify_fn if self._rss_device
+                       else make_sharded_classify_fn)
+            self._classify = make_fn(
                 self._mesh,
                 probe_depth=self.config.probe_depth,
                 v4_only=self.config.v4_only,
@@ -364,6 +374,13 @@ class JITDatapath(DatapathBackend):
         self._hbm_groups: Dict[str, int] = {}
         self._hbm_places = 0
         self._hbm_patches = 0
+        # device-RSS exchange accounting: the ring ppermute's gathered
+        # request/reply buffers are transient per-dispatch device tensors;
+        # the ledger's ``exchange`` group carries the PEAK bytes any
+        # dispatched bucket materialized (the budget-relevant number),
+        # rss_exchange_stats() the last/peak occupancy pair
+        self._exchange_last_bytes = 0
+        self._exchange_peak_bytes = 0
         self._account_ct_hbm()
         self._scatter_fn = None            # jitted donated row scatter
         # overlapped CT GC (kernels/conntrack.ct_sweep_chunk): cursor into
@@ -382,8 +399,44 @@ class JITDatapath(DatapathBackend):
     def pipeline_shards(self) -> int:
         """The ingestion pipeline steers for the flow axis only — rule
         shards replicate the batch, so a rules-only mesh needs no row
-        grouping at all."""
+        grouping at all. With device-side RSS (rss_mode="device") the
+        answer is 1: row order carries NO placement semantics — the
+        pipeline stages contiguously, the feeder skips pre-binning, and
+        the shard_map body's ppermute exchange owns flow→shard
+        resolution."""
+        if self._rss_device:
+            return 1
         return self.n_flow_shards if self._sharded else 1
+
+    @property
+    def rss_state(self) -> Dict[str, Any]:
+        """Operator-facing RSS-mode surface: where flow→shard resolution
+        runs ("host" steering vs the "device" ppermute exchange), the
+        mesh's flow-axis size, and whether device mode is actually active
+        (it needs a flow-sharded mesh)."""
+        return {
+            "mode": "device" if self._rss_device else "host",
+            "shards": self.n_flow_shards if self._sharded else 1,
+            "active": self._rss_device,
+        }
+
+    def rss_exchange_stats(self) -> Optional[Dict[str, int]]:
+        """Exchange-buffer occupancy for the resource ledger (device RSS
+        only): bytes the last dispatched bucket's gathered request/reply
+        buffers materialized across the mesh against the worst case at
+        ``batch_size`` — the device-transient twin of the wire pool's
+        host-side row."""
+        if not self._rss_device:
+            return None
+        from cilium_tpu.parallel.exchange import exchange_bytes
+        cap = exchange_bytes(self.config.batch_size, self.n_flow_shards)
+        with self._hbm_lock:
+            # capacity tracks the largest bucket actually dispatched when
+            # a caller runs bigger-than-batch_size buckets (bench A/B
+            # shape-parity runs) — occupancy must never exceed capacity
+            return {"capacity": max(cap, self._exchange_peak_bytes),
+                    "in_use": self._exchange_last_bytes,
+                    "peak": self._exchange_peak_bytes}
 
     @property
     def fused_state(self) -> Dict[str, Any]:
@@ -757,6 +810,11 @@ class JITDatapath(DatapathBackend):
         in-flight steps by itself."""
         jnp = self._jnp
         if self._sharded:
+            if self._rss_device:
+                # device-side RSS: no steering anywhere — rows ship in
+                # arrival order and the shard_map body's ppermute exchange
+                # resolves CT ownership (pre_steered is meaningless)
+                return self._classify_async_device(placed, snap, batch, now)
             return self._classify_async_sharded(placed, snap, batch, now,
                                                 pre_steered=pre_steered)
         # observe/trace: the pack/transfer/compute split attaches to the
@@ -978,6 +1036,101 @@ class JITDatapath(DatapathBackend):
                 self._wire_buf_release(wire_key, wire_buf)
             if scatter is not None:
                 out_np = unsteer_outputs(out_np, scatter)
+            return out_np, counters_np
+        return finalize
+
+    def _classify_async_device(self, placed, snap, batch, now):
+        """The device-RSS overlap stage: the batch ships in plain ARRIVAL
+        order — packed in place into one pooled wire buffer whose equal
+        per-chip slices are the transfers (P('flows') splits dim 0) — and
+        flow→shard resolution happens inside the shard_map body via the
+        ring ppermute CT exchange (parallel/exchange.py). There is no
+        steer span, no scatter, and no un-steer: outputs come back in the
+        same FIFO row order they were submitted in.
+
+        The only shape contract is divisibility: each chip takes an equal
+        pow2 arrival-order slice, so arbitrary-size control-plane batches
+        (health probes, CLI classify) pad to the next pow2 multiple of
+        the mesh with invalid rows (mirroring the steered path's
+        round_to_pow2 trace discipline) and finalize truncates the
+        padding. Pipeline buckets are pow2 >= the mesh by construction
+        (the engine clamps min_bucket) and ship unpadded."""
+        import jax
+        from cilium_tpu.parallel.exchange import exchange_bytes
+        jnp = self._jnp
+        tracer, trace_id = active_trace()
+        n = self.n_flow_shards
+        with tracer.span(trace_id, "datapath.pack",
+                         shards=n, rss="device"):
+            b = self._columnar(batch)
+            orig_rows = int(b["valid"].shape[0])
+            rows = orig_rows
+            if rows % n or rows & (rows - 1):
+                from cilium_tpu.kernels.records import empty_batch
+                per = 1 << max(0, (-(-rows // n) - 1).bit_length())
+                rows = per * n
+                pb = empty_batch(rows)
+                for k, col in pb.items():
+                    col[:orig_rows] = b[k]
+                b = pb
+            if not self.config.zero_copy_ingest:
+                with self._pack_lock:
+                    self.pack_stats["pack_fallback_disabled"] += 1
+                wire = path_dict = None
+                wire_key = wire_buf = None
+                dict_batch = {k: b[k] for k in self._BATCH_KEYS}
+                nbytes = sum(v.nbytes for v in dict_batch.values())
+            else:
+                dict_batch = None
+                wire, path_dict, wire_key, wire_buf = self._pack_wire(
+                    b, snap, pooled=True, fallback_reason="shape")
+                nbytes = int(wire.nbytes)
+        # exchange-buffer accounting (the HBM ledger's ``exchange`` group):
+        # per-mesh bytes the ring materializes for this bucket shape
+        ex_bytes = exchange_bytes(rows, n)
+        with self._hbm_lock:
+            self._exchange_last_bytes = ex_bytes
+            if ex_bytes > self._exchange_peak_bytes:
+                self._exchange_peak_bytes = ex_bytes
+                self._hbm_groups["exchange"] = ex_bytes
+        try:
+            with tracer.span(trace_id, "datapath.transfer", bytes=nbytes,
+                             shards=n):
+                FAULTS.fire("datapath.transfer")
+                FAULTS.fire("ct.insert")
+                if dict_batch is not None:
+                    dev_batch = dict_batch   # the jit shards the columns
+                elif path_dict is not None:
+                    dev_batch = (jax.device_put(wire, self._batch_sharding),
+                                 self._upload_path_dict(path_dict))
+                else:
+                    dev_batch = jax.device_put(wire, self._batch_sharding)
+                with self._ct_lock:
+                    self._check_placed(placed)
+                    out, new_ct, counters = self._classify(
+                        dict(placed), self._ct, dev_batch, jnp.uint32(now),
+                        jnp.int32(snap.world_index))
+                    self._ct = new_ct
+        except BaseException:
+            self._wire_buf_shed(wire_key)    # finalize will never run
+            raise
+
+        def finalize():
+            try:
+                with tracer.span(trace_id, "datapath.compute",
+                                 fused=int(self._fused)):
+                    out_np = {k: np.asarray(v) for k, v in out.items()}
+                    counters_np = {k: np.asarray(v)
+                                   for k, v in counters.items()}
+            except BaseException:
+                self._wire_buf_shed(wire_key)  # failed materialization
+                raise
+            if wire_key is not None:
+                self._wire_buf_release(wire_key, wire_buf)
+            if orig_rows != rows:
+                # padded control-plane batch: outputs are already FIFO —
+                # dropping the invalid tail is the whole "un-steer"
+                out_np = {k: v[:orig_rows] for k, v in out_np.items()}
             return out_np, counters_np
         return finalize
 

@@ -665,13 +665,26 @@ class Engine:
                 # flow-sharded backends (the multi-chip mesh) want batches
                 # pre-steered: the pipeline's staging ring grows per-shard
                 # segments and steers at stage-write time, so one submit()
-                # saturates every chip behind the one admission queue
+                # saturates every chip behind the one admission queue.
+                # With device-side RSS (rss_mode="device") pipeline_shards
+                # is 1 — rows stage contiguously in arrival order, direct
+                # bucket-shaped dispatch comes back, and the shard_map
+                # body's ppermute exchange owns flow→shard resolution; the
+                # mesh size rides along for the per-mesh guard surface.
                 shards = getattr(self.datapath, "pipeline_shards", 1)
+                rss = getattr(self.datapath, "rss_state", None) or {}
+                rss_mode = rss.get("mode", "host")
+                mesh_shards = rss.get("shards", shards)
                 self._pipeline_sharded = shards > 1
+                min_bucket = min(cfg.pipeline_min_bucket, cfg.batch_size)
+                if rss_mode == "device":
+                    # every bucket must divide the mesh's flow axis (each
+                    # chip takes an equal pow2 arrival-order slice)
+                    min_bucket = max(min_bucket, mesh_shards)
                 self._pipeline = Pipeline(
                     self._pipeline_dispatch, metrics=self.metrics,
                     max_bucket=cfg.batch_size,
-                    min_bucket=min(cfg.pipeline_min_bucket, cfg.batch_size),
+                    min_bucket=min_bucket,
                     queue_batches=cfg.pipeline_queue_batches,
                     admission=cfg.pipeline_admission,
                     block_timeout_s=cfg.pipeline_block_timeout_s,
@@ -693,6 +706,8 @@ class Engine:
                     shard_rev_fn=(lambda: self._active.revision
                                   if self._active is not None else -1)
                     if shards > 1 else None,
+                    mesh_shards=mesh_shards,
+                    rss_mode=rss_mode,
                     event_sink=self._pipeline_event)
             return self._pipeline
 
@@ -844,10 +859,13 @@ class Engine:
                 poll_budget=cfg.ingest_poll_budget,
                 idle_sleep_s=cfg.ingest_idle_sleep_s,
                 slo_ms=cfg.slo_e2e_ms,
-                # sharded mesh: harvest computes the flow-shard hash during
+                # steered mesh: harvest computes the flow-shard hash during
                 # ep-slot mapping (vectorized, shares flow_shard_of) so the
                 # staging ring's flush-time scatter is a copy, not a
-                # re-hash — the feeder IS the software RSS
+                # re-hash — the feeder IS the software RSS. With
+                # rss_mode="device" pipeline_shards is 1: pre-binning
+                # disappears from the harvest path entirely (the in-kernel
+                # ppermute exchange owns flow→shard resolution).
                 n_shards=getattr(self.datapath, "pipeline_shards", 1),
                 metrics=self.metrics, tracer=self.tracer,
                 # SHED-NEW harvest drops narrate to the flight recorder
@@ -1161,6 +1179,15 @@ class Engine:
         if hl is not None and self.config.max_hbm_bytes > 0:
             out["hbm"] = (self.config.max_hbm_bytes,
                           hl()["device_bytes"])
+        rs = getattr(dp, "rss_exchange_stats", None)
+        if rs is not None:
+            s = rs()
+            if s is not None:
+                # device-RSS ppermute exchange buffers: transient per
+                # dispatch and sized by the bucket shape — informational
+                # occupancy against the worst case at batch_size (a full
+                # bucket is the steady serving state, not a failure)
+                out["rss_exchange"] = (s["capacity"], s["in_use"], 0.0)
         import sys as _sys
         cls_mod = _sys.modules.get("cilium_tpu.kernels.classify")
         if cls_mod is not None:
@@ -1431,8 +1458,11 @@ class Engine:
                 "restarts": ps["restarts"],
                 "breaker": ps["breaker"],
                 # per-mesh guard surface: a non-ok state fences this many
-                # chips at once (no half-mesh verdicts)
-                "shards": ps.get("n_shards", 1),
+                # chips at once (no half-mesh verdicts) — the MESH size,
+                # which device-RSS pipelines keep even though their
+                # staging ring is unsharded
+                "shards": ps.get("mesh_shards") or ps.get("n_shards", 1),
+                "rss_mode": ps.get("rss_mode", "host"),
             }
             from cilium_tpu.pipeline.guard import PIPELINE_STATES
             self.metrics.set_gauge("pipeline_state",

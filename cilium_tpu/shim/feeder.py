@@ -178,16 +178,20 @@ class ShimFeeder:
         self._est_filter = np.zeros((EST_FILTER_SLOTS,), dtype=np.uint32)
         self._est_mask = np.uint32(EST_FILTER_SLOTS - 1)
         if n_shards > 1:
-            # software RSS (SURVEY §2): harvest pre-bins each record by the
-            # direction-normalized flow hash so the pipeline's flush-time
-            # scatter is a plain copy, never a re-hash. The column carries
-            # the SHARD_BIN encoding — shard+1 in the low bits (0 = "not
-            # binned", the staging ring's convention for optional ``_*``
-            # columns) and the binning policy revision above, so a bin
-            # hashed under a superseded LB table is re-hashed at
-            # stage-write instead of stranding a service flow's CT entry
-            # on the wrong shard — and rides the same reusable poll
-            # buffers.
+            # software RSS (SURVEY §2), HOST steering mode only: harvest
+            # pre-bins each record by the direction-normalized flow hash
+            # so the pipeline's flush-time scatter is a plain copy, never
+            # a re-hash. With ``rss_mode="device"`` the engine passes
+            # n_shards=1 (the datapath's ``pipeline_shards``) and this
+            # whole block — the harvest-side half of the host RSS tax —
+            # disappears: the in-kernel ppermute exchange owns flow→shard
+            # resolution. The column carries the SHARD_BIN encoding —
+            # shard+1 in the low bits (0 = "not binned", the staging
+            # ring's convention for optional ``_*`` columns) and the
+            # binning policy revision above, so a bin hashed under a
+            # superseded LB table is re-hashed at stage-write instead of
+            # stranding a service flow's CT entry on the wrong shard —
+            # and rides the same reusable poll buffers.
             for buf in self._free:
                 buf["_shard"] = np.zeros((shim.batch_size,), dtype=np.int64)
         self._pending: deque = deque()     # (ticket, buf) in harvest order
