@@ -10,7 +10,8 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        ddos-smoke cluster-smoke pressure-smoke rss-smoke shim bench clean
+        ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke shim \
+        bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -165,7 +166,25 @@ rss-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_rss.py -q -m slow
 	$(PYTEST_ENV) python bench.py --pipeline --config 1 --shards 4 --rss device --preset smoke > /tmp/cilium_tpu_rss_gate.json
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke
+# Multi-tenant QoS gate (cilium_tpu/qos): the tier-1 QoS subset — tenant
+# spec/LUT mechanics, DRR weight shares + FIFO-within-tenant + the
+# zero-weight starvation floor + the lane bypass debt bound, tenant-scoped
+# caps / over-share fail-fast / priority displacement, the `qos.enqueue`
+# fail-closed fault, the QoS-off byte-identical surface, engine parity
+# with the auditor at 1.0 while QoS is armed — plus the slow-marked
+# 8-shard mixed-tenant soak (concurrent `{tenant=}` metric scrapes racing
+# a mid-soak watchdog restart), and a `bench.py --tenants` cfg8 round
+# whose gate (victim survival ≥99%, lane p99 within budget under the
+# flood, the flooder's DRR share confined to its 1/7 weight band, zero
+# parity mismatches) exits 4 on failure, --compare'd against itself for
+# the round-over-round per-tenant surface.
+qos-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_qos.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_qos.py -q -m slow
+	$(PYTEST_ENV) python bench.py --tenants > /tmp/cilium_tpu_qos_gate.json
+	$(PYTEST_ENV) python bench.py --tenants --compare /tmp/cilium_tpu_qos_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -1597,6 +1597,57 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
     finally:
         shutil.rmtree(state, ignore_errors=True)
 
+    # -- phase 5: qos.enqueue fail-closed (multi-tenant QoS) ----------------
+    # tenant classification at admission blows up: every faulted submission
+    # must fail CLOSED onto the default tenant's FIFO budget — served,
+    # never dropped, verdicts bit-identical — and the worker keeps running
+    import numpy as np
+    qcfg = DaemonConfig(ct_capacity=4096, auto_regen=False,
+                        qos_enabled=True,
+                        qos_tenants="gold=4:lane,bulk=1",
+                        pipeline_max_restarts=5,
+                        pipeline_restart_backoff_s=0.05)
+    qdp = None
+    if datapath_kind == "fake":
+        from cilium_tpu.runtime.datapath import FakeDatapath
+        qdp = FakeDatapath(qcfg)
+    qeng = Engine(qcfg, datapath=qdp)
+    qeng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+    qeng.apply_policy(_CHAOS_POLICY)
+    qslot = qeng.active.snapshot.ep_slot_of
+    gold_tid = {v: k for k, v in qeng.qos.tenants().items()}["gold"]
+    n_fault, n_sub = 4, 12
+    FAULTS.arm("qos.enqueue", mode="fail", times=n_fault)
+    qtickets = []
+    for i in range(n_sub):
+        qb = mk_batch(qslot)
+        qb["_tenant"] = np.full(qb["valid"].shape, gold_tid,
+                                dtype=np.int32)
+        qtickets.append(qeng.submit(qb, now=800 + i))
+    qdrained = qeng.drain(timeout=60)
+    FAULTS.disarm("qos.enqueue")
+    q_errors = q_div = 0
+    for t in qtickets:
+        try:
+            out = t.result(timeout=5)
+        except Exception:
+            q_errors += 1
+            continue
+        if [bool(a) for a in out["allow"]] != baseline:
+            q_div += 1
+    failsafe = qeng.metrics.counters.get("qos_enqueue_failsafe_total", 0)
+    fell = sum(1 for t in qtickets if t.tenant == "default")
+    qstats = qeng.pipeline_stats() or {}
+    report.record(
+        "qos-enqueue-failsafe",
+        qdrained and q_errors == 0 and q_div == 0
+        and failsafe == n_fault and fell == n_fault
+        and qstats.get("state") == "ok",
+        f"{n_fault} injected classification faults over {n_sub} "
+        f"submissions: {failsafe} fail-closed to the default tenant "
+        f"({fell} tickets), {q_errors} errors, {q_div} verdict "
+        f"divergences, state={qstats.get('state')}")
+
 
 def _chaos_live(args, report: _ChaosReport) -> None:
     """Drive the chaos scenario against a running agent over its REST

@@ -14,6 +14,15 @@ the process lifetime) and nudges two knobs inside configured bounds:
 - ``min_bucket`` (the smallest dispatch shape) — deadline-dominated flushes
   at low fill → smaller floor (less padding per dispatch); near-full
   dispatches everywhere → larger floor (fewer, bigger device steps).
+- ``lane_bucket`` (the latency lane's dispatch floor; armed only under
+  multi-tenant QoS — zero otherwise and the arm never runs) — lane wait
+  p99 over its budget or lane fill far under target → smaller lane shapes
+  (less padding, faster small dispatches); lane batches near-filling the
+  bucket while p99 is comfortable → grow toward ``min_bucket`` (the lane
+  converges back to bulk shapes when it carries bulk-sized traffic). The
+  autotuner arbitrates the lane/bulk split INSIDE its existing bounds:
+  ``lane_bucket`` never exceeds ``min_bucket`` and never drops under the
+  lane floor, with the same hysteresis/dead-band discipline.
 
 Stability over reactivity: a change needs ``hysteresis`` *consecutive*
 same-direction intervals, steps are capped multiplicative factors, and the
@@ -37,6 +46,10 @@ from cilium_tpu.runtime.metrics import (Metrics, quantile_from,
 log = logging.getLogger("cilium_tpu.autotune")
 
 QUEUE_WAIT_HIST = "pipeline_queue_wait_seconds"
+LANE_WAIT_HIST = "pipeline_lane_wait_seconds"
+
+#: smallest latency-lane dispatch shape the autotuner will choose (pow2)
+LANE_BUCKET_FLOOR = 8
 
 
 class Autotuner:
@@ -51,6 +64,8 @@ class Autotuner:
                  min_bucket_floor: Optional[int] = None,
                  target_fill: float = 0.7,
                  queue_wait_p99_budget_ms: float = 10.0,
+                 lane_wait_p99_budget_ms: Optional[float] = None,
+                 lane_bucket_floor: int = LANE_BUCKET_FLOOR,
                  hysteresis: int = 3, step_factor: float = 1.5,
                  min_interval_batches: int = 4):
         if flush_ms_min <= 0 or flush_ms_max < flush_ms_min:
@@ -65,6 +80,12 @@ class Autotuner:
         self.min_bucket_floor = min_bucket_floor
         self.target_fill = target_fill
         self.budget_ms = queue_wait_p99_budget_ms
+        # the lane is the latency product: default its budget to half the
+        # bulk queue-wait budget rather than growing a config knob
+        self.lane_budget_ms = lane_wait_p99_budget_ms \
+            if lane_wait_p99_budget_ms is not None \
+            else 0.5 * queue_wait_p99_budget_ms
+        self.lane_bucket_floor = max(1, int(lane_bucket_floor))
         self.hysteresis = hysteresis
         self.step_factor = step_factor
         self.min_interval_batches = min_interval_batches
@@ -75,6 +96,9 @@ class Autotuner:
         self._last_reasons: Dict[str, int] = {}
         self._flush_streak = 0          # +n consecutive "up", -n "down"
         self._bucket_streak = 0
+        self._lane_streak = 0
+        self._last_lane = (0, 0)        # (lane_fill_rows, lane_bucket_rows)
+        self._last_lane_counts: Optional[List[int]] = None
         # bounded decision history (the /v1/status surface only shows the
         # tail; a long-lived daemon must not accumulate dicts forever)
         self.adjustments: Deque[Dict] = deque(maxlen=64)
@@ -164,9 +188,64 @@ class Autotuner:
                 self._decide(obs, "min_bucket", old_b, new_b)
             self._bucket_streak = 0
 
+        # -- lane_bucket (latency-lane floor; QoS only — zero disarms) -------
+        if getattr(pl, "lane_bucket", 0):
+            self._lane_step(pl, obs, stats)
+            self.metrics.set_gauge("autotune_lane_bucket", pl.lane_bucket)
+
         self.metrics.set_gauge("autotune_flush_ms", pl.flush_ms)
         self.metrics.set_gauge("autotune_min_bucket", pl.min_bucket)
         return obs
+
+    def _lane_step(self, pl, obs: Dict, stats: Dict) -> None:
+        """The lane/bulk arbitration arm. Same interval-diff + streak
+        machinery as the bulk knobs, driven by the lane's own signals:
+        ``pipeline_lane_wait_seconds`` p99 and the lane fill ratio
+        (lane_fill_rows / lane_bucket_rows over the interval)."""
+        lane_fill = stats.get("lane_fill_rows", 0)
+        lane_rows = stats.get("lane_bucket_rows", 0)
+        d_lfill = lane_fill - self._last_lane[0]
+        d_lrows = lane_rows - self._last_lane[1]
+        self._last_lane = (lane_fill, lane_rows)
+        p99_ms = None
+        hist = self.metrics.histograms.get(LANE_WAIT_HIST)
+        if hist is not None:
+            buckets, counts, _total, _n = hist.snapshot()
+            prev = self._last_lane_counts
+            self._last_lane_counts = list(counts)
+            if prev is not None and len(prev) == len(counts):
+                p99 = quantile_from(
+                    buckets, [c - p for c, p in zip(counts, prev)], 0.99)
+                if not quantile_is_empty(p99):
+                    p99_ms = p99 * 1e3
+        if d_lrows <= 0:
+            self._lane_streak = 0        # idle lane: no signal, no drift
+            return
+        fill = d_lfill / d_lrows
+        obs["lane_bucket"] = pl.lane_bucket
+        obs["lane_fill_ratio"] = round(fill, 4)
+        if p99_ms is not None:
+            obs["lane_wait_p99_ms"] = round(p99_ms, 3)
+        floor = self.lane_bucket_floor
+        ceil = pl.min_bucket              # the lane never exceeds bulk's floor
+        over = p99_ms is not None and p99_ms > self.lane_budget_ms
+        calm = p99_ms is None or p99_ms < 0.5 * self.lane_budget_ms
+        if (over or fill < 0.5 * self.target_fill) \
+                and pl.lane_bucket > floor:
+            lwant = -1
+        elif fill >= 0.9 and calm and pl.lane_bucket < ceil:
+            lwant = +1
+        else:
+            lwant = 0
+        self._lane_streak = self._advance(self._lane_streak, lwant)
+        if abs(self._lane_streak) >= self.hysteresis:
+            old = pl.lane_bucket
+            new = old * 2 if self._lane_streak > 0 else old // 2
+            new = min(ceil, max(floor, new))
+            if new != old:
+                pl.set_lane_bucket(new)
+                self._decide(obs, "lane_bucket", old, new)
+            self._lane_streak = 0
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
@@ -199,12 +278,17 @@ class Autotuner:
                  obs["fill_ratio"])
 
     def status(self) -> Dict:
+        lane = getattr(self.pipeline, "lane_bucket", 0)
         return {
             "flush_ms": self.pipeline.flush_ms,
             "min_bucket": self.pipeline.min_bucket,
             "bounds": {"flush_ms": [self.flush_ms_min, self.flush_ms_max],
                        "min_bucket": [self.min_bucket_floor or 1,
-                                      self.pipeline.max_bucket]},
+                                      self.pipeline.max_bucket],
+                       **({"lane_bucket": [self.lane_bucket_floor,
+                                           self.pipeline.min_bucket]}
+                          if lane else {})},
+            **({"lane_bucket": lane} if lane else {}),
             "adjustments": list(self.adjustments)[-20:],
             "adjustments_total": self.adjustments_total,
         }

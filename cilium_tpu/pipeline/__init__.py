@@ -8,9 +8,10 @@ layer (deadlines, circuit breaker, watchdog-supervised restart).
 from cilium_tpu.pipeline.guard import (CircuitBreaker, PipelineClosed,
                                        PipelineDeadlineExceeded,
                                        PipelineDrop, PipelineError,
+                                       PipelineTenantCap,
                                        PipelineUnavailable, Watchdog)
 from cilium_tpu.pipeline.scheduler import Pipeline, Ticket
 
 __all__ = ["CircuitBreaker", "Pipeline", "PipelineClosed",
            "PipelineDeadlineExceeded", "PipelineDrop", "PipelineError",
-           "PipelineUnavailable", "Ticket", "Watchdog"]
+           "PipelineTenantCap", "PipelineUnavailable", "Ticket", "Watchdog"]

@@ -104,6 +104,14 @@ class PipelineUnavailable(PipelineError):
     API — the backend is sick, not merely busy."""
 
 
+class PipelineTenantCap(PipelineDrop):
+    """Per-tenant occupancy-cap shed (multi-tenant QoS): the submitter is
+    at its OWN queue budget while the shared queue may still have room —
+    isolation working as designed, not a cluster-wide overload. A
+    :class:`PipelineDrop` subclass, so every existing retryable-429
+    handler treats it correctly without knowing about tenants."""
+
+
 class CircuitBreaker:
     """Consecutive-failure circuit breaker for the dispatch path.
 

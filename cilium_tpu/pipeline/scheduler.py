@@ -97,6 +97,7 @@ from cilium_tpu.pipeline.guard import (OVERLOAD_OVERLOAD, OVERLOAD_PRESSURE,
                                        CircuitBreaker, PipelineClosed,
                                        PipelineDeadlineExceeded,
                                        PipelineDrop, PipelineError,
+                                       PipelineTenantCap,
                                        PipelineUnavailable, Watchdog)
 from cilium_tpu.runtime.faults import FAULTS, FaultInjected
 from cilium_tpu.runtime.metrics import Metrics
@@ -178,13 +179,17 @@ class Ticket:
     the serial classify path)."""
 
     __slots__ = ("seq", "n_rows", "n_valid", "submitted_mono", "trace_id",
-                 "deadline_mono", "ingest_mono", "_event", "_out", "_exc")
+                 "deadline_mono", "ingest_mono", "tenant", "_event", "_out",
+                 "_exc")
 
     def __init__(self, n_rows: int, n_valid: int):
         self.seq = -1                      # assigned at admission
         self.n_rows = n_rows
         self.n_valid = n_valid
         self.trace_id = None               # observe/trace sampling decision
+        # tenant NAME (QoS armed only; None otherwise) — rides the ticket
+        # so sheds can carry a {tenant=} label without a table lookup
+        self.tenant: Optional[str] = None
         self.submitted_mono = time.monotonic()
         # when the rows actually entered the host (the shim feeder's
         # harvest stamp, monotonic seconds) — what true ingest→verdict
@@ -234,18 +239,34 @@ def _batch_prio(batch: Dict[str, np.ndarray]) -> int:
     return int(p.min()) if p.size else PRIO_NEW
 
 
+def _batch_tenant(batch: Dict[str, np.ndarray]) -> int:
+    """A submission's tenant: the DOMINANT ``_tenant`` id among its valid
+    rows — a couple of stray rows must not reclassify a whole harvest
+    batch onto another tenant's budget. Producers without the column
+    (control plane, tests, QoS-off feeders) land on the default tenant."""
+    col = batch.get("_tenant")
+    if col is None:
+        return 0
+    t = np.asarray(col)[np.asarray(batch["valid"], dtype=bool)]
+    if not t.size:
+        return 0
+    vals, counts = np.unique(t, return_counts=True)
+    return int(vals[int(np.argmax(counts))])
+
+
 class _Sub:
     """One admitted submission riding the queue. ``valid_idx`` is computed
     lazily on the worker — the direct-dispatch fast path never needs it."""
 
-    __slots__ = ("ticket", "batch", "now", "prio")
+    __slots__ = ("ticket", "batch", "now", "prio", "tenant")
 
     def __init__(self, ticket: Ticket, batch: Dict[str, np.ndarray],
-                 now: Optional[int], prio: int = PRIO_NEW):
+                 now: Optional[int], prio: int = PRIO_NEW, tenant: int = 0):
         self.ticket = ticket
         self.batch = batch
         self.now = now
         self.prio = prio
+        self.tenant = tenant
 
 
 class _Slice:
@@ -343,12 +364,18 @@ class Pipeline:
                  shard_rev_fn: Optional[Callable[[], int]] = None,
                  mesh_shards: int = 0,
                  rss_mode: str = "host",
-                 event_sink: Optional[Callable] = None):
+                 event_sink: Optional[Callable] = None,
+                 qos=None,
+                 lane_bucket: int = 0):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
             raise ValueError("min_bucket must be a power of two "
                              "<= max_bucket")
+        if lane_bucket and (lane_bucket & (lane_bucket - 1)
+                            or not 0 < lane_bucket <= max_bucket):
+            raise ValueError("lane_bucket must be 0 (lane off) or a power "
+                             "of two <= max_bucket")
         if admission not in ("block", "drop"):
             raise ValueError(f"bad admission mode {admission!r}")
         if inflight < 1 or queue_batches < 1:
@@ -442,9 +469,22 @@ class Pipeline:
         # producer immediately, not park its threads)
         self._overload_level = 0
 
+        # multi-tenant QoS (cilium_tpu/qos): when a TenantTable is passed
+        # the admission queue becomes per-tenant weighted-fair (DRR); with
+        # qos=None the queue is the plain FIFO deque — byte-identical to
+        # the pre-QoS pipeline, which is what keeps the default-off
+        # contract trivially true
+        self._qos = qos
+        self._lane_bucket = lane_bucket if qos is not None else 0
+
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue: deque = deque()
+        if qos is not None:
+            from cilium_tpu.qos.wfq import TenantQueues
+            self._queue = TenantQueues(qos, quantum_rows=max_bucket,
+                                       lane_rows=self._lane_bucket)
+        else:
+            self._queue = deque()
         self._outstanding = 0            # accepted tickets not yet resolved
         self._drain_req = 0
         self._closing = False
@@ -492,9 +532,13 @@ class Pipeline:
         self.shed_reasons: Dict[str, int] = {}
         self.unavailable_total = 0
         self.flush_reasons: Dict[str, int] = {
-            "direct": 0, "full": 0, "deadline": 0, "drain": 0}
+            "direct": 0, "full": 0, "deadline": 0, "drain": 0, "lane": 0}
         self._fill_rows = 0
         self._bucket_rows = 0
+        # latency-lane fill accounting (reason="lane" dispatches only) —
+        # the autotuner's lane/bulk arbitration signal
+        self._lane_fill_rows = 0
+        self._lane_bucket_rows = 0
         self._pub: Dict = {}             # worker-published stats snapshot
 
         if self._mesh_shards > 1:
@@ -579,6 +623,19 @@ class Pipeline:
         deadline = time.monotonic() + (
             self._block_timeout_s if timeout is None else timeout)
         prio = _batch_prio(batch)
+        tenant = 0
+        if self._qos is not None:
+            # classify-time tenant derivation is a guarded shed path
+            # (fault point "qos.enqueue"): if it faults, the ticket fails
+            # CLOSED onto the default-tenant FIFO class — served, just
+            # without a private budget — and the producer thread survives
+            try:
+                FAULTS.fire("qos.enqueue")
+                tenant = _batch_tenant(batch)
+            except FaultInjected:
+                self.metrics.inc_counter("qos_enqueue_failsafe_total")
+                tenant = 0
+            ticket.tenant = self._qos.name_of(tenant)
         victim: Optional[_Sub] = None
         with self._lock:
             if self._closing or self._closed:
@@ -591,32 +648,70 @@ class Pipeline:
                 raise PipelineUnavailable(
                     f"pipeline hard-failed after {self._restarts} worker "
                     "restarts; no new submissions")
-            while len(self._queue) >= self._queue_max:
-                if self._overload_level >= OVERLOAD_PRESSURE \
+            qs = self._queue if self._qos is not None else None
+            while True:
+                qfull = len(self._queue) >= self._queue_max
+                # per-tenant occupancy cap (QoS only): the tenant is at
+                # its OWN budget even if the shared queue has room — it
+                # waits/sheds against that budget, never spending the
+                # other tenants' headroom
+                tcap = qs is not None and qs.over_cap(tenant)
+                if not qfull and not tcap:
+                    break
+                if qfull and self._overload_level >= OVERLOAD_PRESSURE \
                         and victim is None:
                     # priority shedding (the degradation ladder's PRESSURE
                     # behavior): a full queue sheds its WORST-ranked
                     # submission in favor of a better-ranked newcomer —
                     # established-flow batches displace flood batches
                     # instead of queueing behind them. Same-class traffic
-                    # keeps the plain FIFO admission below.
-                    victim = self._priority_victim_locked(prio)
+                    # keeps the plain FIFO admission below. With QoS armed
+                    # the scan is tenant-scoped: the worst-PRESSURE tenant
+                    # (queue depth over weight) sheds first, and within
+                    # the submitter's own tenant the old strictly-worse-
+                    # class contract still holds.
+                    victim = (self._queue.priority_victim(prio, tenant)
+                              if qs is not None
+                              else self._priority_victim_locked(prio))
                     if victim is not None:
                         self._queue.remove(victim)
                         self.metrics.set_gauge("pipeline_queue_depth",
                                                len(self._queue))
-                        break
+                        if qs is None or not qs.over_cap(tenant):
+                            break
                 remaining = deadline - time.monotonic()
-                if self._admission == "drop" or remaining <= 0 \
-                        or self._overload_level >= OVERLOAD_OVERLOAD:
+                # OVERLOAD fail-fast is tenant-scoped under QoS: only a
+                # tenant at-or-over its weight share of the queue is
+                # instant-rejected; a within-budget tenant still gets the
+                # blocking wait (its backlog is someone else's flood)
+                fail_fast = self._overload_level >= OVERLOAD_OVERLOAD \
+                    and (qs is None or qs.over_share(tenant))
+                if self._admission == "drop" or remaining <= 0 or fail_fast:
+                    if tcap and not qfull:
+                        # the tenant's own cap is the binding constraint:
+                        # this is a shed against its private budget, not a
+                        # shared-queue admission drop
+                        self.shed_total += 1
+                        self.shed_reasons["tenant_cap"] = \
+                            self.shed_reasons.get("tenant_cap", 0) + 1
+                        self.metrics.inc_counter(
+                            f'pipeline_shed_total{{reason="tenant_cap",'
+                            f'tenant="{ticket.tenant}"}}')
+                        ticket._reject(PipelineTenantCap(
+                            f"tenant {ticket.tenant!r} at its occupancy "
+                            f"cap ({qs.table.cap_of(tenant)} batches); "
+                            f"admission={self._admission}"))
+                        return ticket
                     self.admission_drops += 1
-                    self.metrics.inc_counter("pipeline_admission_drops_total")
+                    self.metrics.inc_counter(
+                        "pipeline_admission_drops_total"
+                        if ticket.tenant is None else
+                        f'pipeline_admission_drops_total'
+                        f'{{tenant="{ticket.tenant}"}}')
                     ticket._reject(PipelineDrop(
                         f"queue full ({self._queue_max} batches); "
                         f"admission={self._admission}"
-                        + (", overload fail-fast"
-                           if self._overload_level >= OVERLOAD_OVERLOAD
-                           else "")))
+                        + (", overload fail-fast" if fail_fast else "")))
                     return ticket
                 self._cond.wait(min(remaining, 0.05))
                 if self._closing or self._closed:
@@ -630,7 +725,8 @@ class Pipeline:
                         "pipeline hard-failed while blocked at admission")
             ticket.seq = self._next_seq
             self._next_seq += 1
-            self._queue.append(_Sub(ticket, batch, now, prio=prio))
+            self._queue.append(_Sub(ticket, batch, now, prio=prio,
+                                    tenant=tenant))
             self.submitted += 1
             self._outstanding += 1
             self.metrics.set_gauge("pipeline_queue_depth", len(self._queue))
@@ -764,6 +860,25 @@ class Pipeline:
         with self._lock:
             self._min_bucket = min_bucket
 
+    @property
+    def lane_bucket(self) -> int:
+        return self._lane_bucket
+
+    def set_lane_bucket(self, lane_bucket: int) -> None:
+        """Move the latency lane's dispatch shape (the always-armed small
+        bucket lane-tenant submissions flush at). 0 disarms the lane;
+        the autotuner arbitrates it within [its floor, min_bucket]."""
+        if lane_bucket and (lane_bucket & (lane_bucket - 1)
+                            or not 0 < lane_bucket <= self._max_bucket):
+            raise ValueError("lane_bucket must be 0 or a power of two "
+                             "<= max_bucket")
+        with self._lock:
+            self._lane_bucket = lane_bucket if self._qos is not None else 0
+            if self._qos is not None:
+                # keep the DRR's lane-bypass threshold in lockstep with
+                # the lane's dispatch shape
+                self._queue.lane_rows = self._lane_bucket
+
     def set_stall_timeout_s(self, stall_timeout_s: float) -> None:
         """Retarget the watchdog's stall budget (e.g. widen it before a
         cold dispatch that will JIT-compile, shrink it in chaos drills)."""
@@ -810,6 +925,11 @@ class Pipeline:
                                         self._inflight_max + 1),
                 "staging_slots": pub.get("staging_slots",
                                          self._inflight_max + 1),
+                # active per-tenant queue occupancy (QoS armed only):
+                # {name: (cap_batches, queued_batches)} for the ledger's
+                # qos_tenant_queue_* rows
+                **({"tenants": self._queue.occupancy_by_name()}
+                   if self._qos is not None else {}),
             }
 
     def stats(self) -> Dict:
@@ -824,6 +944,8 @@ class Pipeline:
             shed_total = self.shed_total
             shed_reasons = dict(self.shed_reasons)
             unavailable = self.unavailable_total
+            tenants = (self._queue.stats() if self._qos is not None
+                       else None)
         qw = self.metrics.histograms.get("pipeline_queue_wait_seconds")
         flush_reasons = pub.get("flush_reasons") or dict(self.flush_reasons)
         fill_rows = pub.get("fill_rows", 0)
@@ -875,6 +997,13 @@ class Pipeline:
             "breaker": self.breaker.stats(),
             "flush_ms": self.flush_ms,
             "min_bucket": self._min_bucket,
+            # multi-tenant QoS surface (absent when QoS is off, so the
+            # QoS-off stats doc is byte-identical to the pre-QoS one)
+            **({"tenants": tenants,
+                "lane_bucket": self._lane_bucket,
+                "lane_fill_rows": pub.get("lane_fill_rows", 0),
+                "lane_bucket_rows": pub.get("lane_bucket_rows", 0)}
+               if tenants is not None else {}),
             "fill_ratio_avg": round(fill_rows / max(1, bucket_rows), 4),
             "queue_wait_p50_ms": round(qw.quantile(0.5) * 1e3, 3)
             if qw else 0.0,
@@ -1146,8 +1275,14 @@ class Pipeline:
         with self._lock:
             self.shed_total += 1
             self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        # QoS armed: the shed is attributed to the ticket's tenant (the
+        # name rode the ticket from admission, no table lookup here);
+        # QoS off keeps the exact pre-QoS family
         self.metrics.inc_counter(
-            f'pipeline_shed_total{{reason="{reason}"}}')
+            f'pipeline_shed_total{{reason="{reason}"}}'
+            if ticket.tenant is None else
+            f'pipeline_shed_total{{reason="{reason}",'
+            f'tenant="{ticket.tenant}"}}')
         self.tracer.record(ticket.trace_id, "pipeline.shed",
                            ticket.submitted_mono,
                            time.monotonic() - ticket.submitted_mono,
@@ -1233,15 +1368,22 @@ class Pipeline:
                                t.submitted_mono, wait)
             self._settle([(t, _zero_out(t.n_rows), None)])
             return
+        # latency lane: a lane-tagged tenant's submission never waits out
+        # the coalesce deadline — it dispatches the moment it stages (at
+        # the small always-armed lane bucket), taking any staged bulk
+        # riders along. Bulk tenants keep the deadline microbatching.
+        lane = bool(self._lane_bucket) and self._qos is not None \
+            and self._qos.is_lane(sub.tenant)
         if self._n_shards > 1:
             # sharded staging: every row must land in its flow shard's
             # segment, so even bucket-shaped submissions stage (no direct
             # bypass — an arbitrary row order carries no shard placement)
-            self._ingest_sharded(sub, gen)
+            self._ingest_sharded(sub, gen, lane=lane)
             return
         rows = t.n_rows
         if (self._staged_rows == 0
-                and self._min_bucket <= rows <= self._max_bucket
+                and (self._lane_bucket if lane
+                     else self._min_bucket) <= rows <= self._max_bucket
                 and rows & (rows - 1) == 0):
             # already bucket-shaped: zero-copy direct dispatch (_current
             # stays set across the hand-off into _dispatching — a ticket
@@ -1285,7 +1427,9 @@ class Pipeline:
         self._staged_slices.append(_Slice(t, valid_idx, pos))
         self._staged_rows += m
         self._publish(gen)
-        if self._staged_rows >= self._max_bucket:
+        if lane:
+            self._flush("lane", gen)
+        elif self._staged_rows >= self._max_bucket:
             self._flush("full", gen)
 
     def _shards_for(self, batch: Dict[str, np.ndarray],
@@ -1317,7 +1461,8 @@ class Pipeline:
         shard = np.asarray(self._shard_fn(batch), dtype=np.int64)
         return shard[valid_idx]
 
-    def _ingest_sharded(self, sub: _Sub, gen: int) -> None:
+    def _ingest_sharded(self, sub: _Sub, gen: int,
+                        lane: bool = False) -> None:
         """Steered staging (the software-RSS half of the multi-chip path):
         each valid row is scattered directly into its flow shard's column
         segment, so flush hands the datapath an already-steered batch and
@@ -1385,7 +1530,12 @@ class Pipeline:
                                           dst_rows=dst_rows))
         self._staged_rows += m
         self._publish(gen)
-        if max(fills) >= self._seg_cap:
+        if lane:
+            # the sharded dispatch shape is the fixed steered layout, so
+            # the lane here only skips the coalesce deadline — no shape
+            # change, no extra XLA traces
+            self._flush("lane", gen)
+        elif max(fills) >= self._seg_cap:
             self._flush("full", gen)
 
     def _flush(self, reason: str, gen: int) -> None:
@@ -1447,7 +1597,12 @@ class Pipeline:
                     stage.dirty[s] = fills[s]
             bucket = self._stage_rows
         else:
-            bucket = max(self._min_bucket, _next_pow2(rows))
+            # lane flushes dispatch at the (smaller) lane floor — padding
+            # a 4-row lane batch to min_bucket would spend the latency
+            # budget the lane exists to protect
+            floor = (self._lane_bucket if reason == "lane"
+                     and self._lane_bucket else self._min_bucket)
+            bucket = max(floor, _next_pow2(rows))
             if rows < bucket:
                 # reused buffer: restore the empty-batch defaults on the
                 # tail, not just the valid mask — stale v6/L7/_ep_raw
@@ -1482,12 +1637,22 @@ class Pipeline:
         self.metrics.inc_counter(f"pipeline_flush_{reason}_total")
         self._fill_rows += n_valid
         self._bucket_rows += bucket_rows
+        if reason == "lane":
+            # lane-only fill accounting: the autotuner's lane/bulk
+            # arbitration reads padding waste from these, separately from
+            # the aggregate fill ratio the bulk knobs are tuned by
+            self._lane_fill_rows += n_valid
+            self._lane_bucket_rows += bucket_rows
         self.metrics.set_gauge("pipeline_fill_ratio",
                                round(n_valid / bucket_rows, 4))
         t0 = time.monotonic()
         qw = self.metrics.histogram("pipeline_queue_wait_seconds")
+        lw = (self.metrics.histogram("pipeline_lane_wait_seconds")
+              if reason == "lane" else None)
         for sl in slices:
             qw.observe(t0 - sl.ticket.submitted_mono)
+            if lw is not None:
+                lw.observe(t0 - sl.ticket.submitted_mono)
             self.tracer.record(sl.ticket.trace_id, "pipeline.admission",
                                sl.ticket.submitted_mono,
                                t0 - sl.ticket.submitted_mono)
@@ -1648,6 +1813,9 @@ class Pipeline:
             "dispatched_batches": self.dispatched_batches,
             "completed_batches": self.completed_batches,
         }
+        if self._qos is not None:
+            snapshot["lane_fill_rows"] = self._lane_fill_rows
+            snapshot["lane_bucket_rows"] = self._lane_bucket_rows
         if self._n_shards > 1:
             snapshot["shard_fill"] = list(self._shard_fill)
             snapshot["shard_rows_total"] = list(self._shard_rows_total)

@@ -1,0 +1,21 @@
+"""Multi-tenant QoS: tenant identity, weighted-fair admission, and the
+latency lane.
+
+- :mod:`cilium_tpu.qos.tenancy` — the tenant registry (names, weights,
+  lane flags, occupancy caps) and the compiled endpoint→tenant LUT the
+  feeder stamps rows with at harvest time.
+- :mod:`cilium_tpu.qos.wfq` — the deficit-round-robin admission queue
+  the pipeline swaps in for its FIFO deque when QoS is armed.
+
+Default-off: with ``qos_enabled=False`` (or a single tenant) the
+pipeline's behavior is byte-identical to the plain FIFO path.
+"""
+
+from cilium_tpu.qos.tenancy import (TENANT_DEFAULT, TENANT_DEFAULT_NAME,
+                                    TenantSpecError, TenantTable,
+                                    parse_assign_spec, parse_tenant_spec)
+from cilium_tpu.qos.wfq import TenantQueues
+
+__all__ = ["TENANT_DEFAULT", "TENANT_DEFAULT_NAME", "TenantQueues",
+           "TenantSpecError", "TenantTable", "parse_assign_spec",
+           "parse_tenant_spec"]

@@ -1,0 +1,284 @@
+"""Tenant model: names, weights, lane flags, occupancy caps, and the
+endpoint→tenant assignment LUT.
+
+Upstream Cilium has no first-class tenant object — isolation is spelled
+through namespaces and bandwidth-manager annotations. Here a *tenant* is
+the serving-path unit of isolation: every harvested row is stamped with a
+tenant id at classify time (``shim/feeder.py``, same compiled-LUT
+discipline as the ep-slot map), and the admission scheduler
+(:mod:`cilium_tpu.qos.wfq`) spends each tenant's budget separately so a
+flooding tenant sheds against its *own* queue, not the cluster's.
+
+Tenant 0 (``default``) always exists: unknown endpoints, QoS-off
+deployments, and fail-closed paths all land there, which is what makes
+the single-tenant case degenerate to today's FIFO bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from cilium_tpu.runtime.faults import register_point
+
+# classify-time shed path: tenant derivation fails → the ticket fails
+# CLOSED into the default-tenant FIFO class and the worker survives
+register_point("qos.enqueue",
+               "tenant classification at admission raises/hangs")
+
+#: the always-present fallback tenant (QoS-off, unknown endpoint,
+#: fail-closed classification). Id 0 so a zero-filled ``_tenant`` column
+#: means "default", matching the zero-fill of absent ``_``-columns.
+TENANT_DEFAULT = 0
+TENANT_DEFAULT_NAME = "default"
+
+#: dense endpoint→tenant LUT ceiling — same bound as the feeder's
+#: ep-slot LUT (``ShimFeeder.DENSE_LUT_MAX``).
+DENSE_LUT_MAX = 1 << 20
+
+#: rows of deficit granted per DRR round even to a zero-weight tenant —
+#: the starvation floor. Any queued tenant accumulates at least this much
+#: credit per full scheduler round, so every tenant is eventually served
+#: no matter how the weights are set.
+WEIGHT_FLOOR_ROWS = 1
+
+
+class TenantSpecError(ValueError):
+    """Malformed ``qos_tenants`` / ``qos_assign`` spec string."""
+
+
+class _Tenant:
+    __slots__ = ("tid", "name", "weight", "lane", "cap")
+
+    def __init__(self, tid: int, name: str, weight: float, lane: bool,
+                 cap: int):
+        self.tid = tid
+        self.name = name
+        self.weight = weight
+        self.lane = lane
+        self.cap = cap              # per-tenant queue cap in batches (0=off)
+
+
+def parse_tenant_spec(spec: str) -> Iterable[Tuple[str, float, bool, int]]:
+    """Parse ``"gold=4:lane,silver=2,bulk=1:cap=8"`` into
+    ``(name, weight, lane, cap)`` tuples. Raises :class:`TenantSpecError`
+    on malformed input (config validation calls this eagerly so a bad
+    spec fails at load, not mid-flood)."""
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        fields = part.split(":")
+        head = fields[0]
+        if "=" not in head:
+            raise TenantSpecError(f"tenant entry {head!r}: want name=weight")
+        name, _, w = head.partition("=")
+        name = name.strip()
+        if not name or not name.replace("-", "").replace("_", "").isalnum():
+            raise TenantSpecError(f"bad tenant name {name!r}")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise TenantSpecError(f"tenant {name!r}: weight {w!r} not a "
+                                  "number") from None
+        if weight < 0:
+            raise TenantSpecError(f"tenant {name!r}: weight must be >= 0")
+        lane = False
+        cap = 0
+        for opt in fields[1:]:
+            opt = opt.strip()
+            if opt == "lane":
+                lane = True
+            elif opt.startswith("cap="):
+                try:
+                    cap = int(opt[4:])
+                except ValueError:
+                    raise TenantSpecError(
+                        f"tenant {name!r}: cap {opt[4:]!r} not an int"
+                    ) from None
+                if cap < 0:
+                    raise TenantSpecError(f"tenant {name!r}: cap must "
+                                          "be >= 0")
+            else:
+                raise TenantSpecError(f"tenant {name!r}: unknown option "
+                                      f"{opt!r}")
+        out.append((name, weight, lane, cap))
+    return out
+
+
+def parse_assign_spec(spec: str) -> Dict[int, str]:
+    """Parse ``"1=gold,2=silver"`` (endpoint id → tenant name)."""
+    out: Dict[int, str] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        ep, _, name = part.partition("=")
+        try:
+            ep_id = int(ep)
+        except ValueError:
+            raise TenantSpecError(f"assign entry {part!r}: endpoint id "
+                                  f"{ep!r} not an int") from None
+        if ep_id <= 0:
+            raise TenantSpecError(f"assign entry {part!r}: endpoint id "
+                                  "must be > 0")
+        if not name.strip():
+            raise TenantSpecError(f"assign entry {part!r}: missing tenant")
+        out[ep_id] = name.strip()
+    return out
+
+
+class TenantTable:
+    """Registry of tenants plus the endpoint→tenant assignment, with a
+    compiled dense LUT cached on a revision counter (rebuild-on-change,
+    same discipline as the feeder's ep-slot LUT)."""
+
+    def __init__(self, default_weight: float = 1.0, default_cap: int = 0):
+        self._lock = threading.Lock()
+        dflt = _Tenant(TENANT_DEFAULT, TENANT_DEFAULT_NAME,
+                       default_weight, False, default_cap)
+        self._by_name: Dict[str, _Tenant] = {dflt.name: dflt}
+        self._by_id: Dict[int, _Tenant] = {dflt.tid: dflt}
+        self._assign: Dict[int, int] = {}          # ep_id -> tid
+        self._next_tid = 1
+        self._default_cap = default_cap
+        self.revision = 0
+        self._lut: Optional[np.ndarray] = None
+        self._lut_rev = -1
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_spec(cls, tenants: str, assign: str = "",
+                  default_weight: float = 1.0,
+                  default_cap: int = 0) -> "TenantTable":
+        tbl = cls(default_weight=default_weight, default_cap=default_cap)
+        for name, weight, lane, cap in parse_tenant_spec(tenants):
+            tbl.register(name, weight=weight, lane=lane,
+                         cap=cap or default_cap)
+        for ep_id, name in parse_assign_spec(assign).items():
+            tbl.assign(ep_id, name)
+        return tbl
+
+    def register(self, name: str, weight: float = 1.0, lane: bool = False,
+                 cap: int = 0) -> int:
+        """Add (or update) a tenant; returns its id."""
+        if weight < 0:
+            raise ValueError("tenant weight must be >= 0")
+        with self._lock:
+            t = self._by_name.get(name)
+            if t is None:
+                t = _Tenant(self._next_tid, name, weight, lane,
+                            cap or self._default_cap)
+                self._next_tid += 1
+                self._by_name[name] = t
+                self._by_id[t.tid] = t
+            else:
+                t.weight = weight
+                t.lane = lane
+                t.cap = cap or self._default_cap
+            self.revision += 1
+            return t.tid
+
+    def remove(self, name: str) -> None:
+        """Tenant departs: its endpoints fall back to ``default``. The id
+        is retired, never reused — in-flight tickets keep a valid name."""
+        if name == TENANT_DEFAULT_NAME:
+            raise ValueError("cannot remove the default tenant")
+        with self._lock:
+            t = self._by_name.pop(name, None)
+            if t is None:
+                return
+            self._by_id.pop(t.tid, None)
+            for ep_id in [e for e, tid in self._assign.items()
+                          if tid == t.tid]:
+                del self._assign[ep_id]
+            self.revision += 1
+
+    def assign(self, ep_id: int, name: str) -> None:
+        with self._lock:
+            t = self._by_name.get(name)
+            if t is None:
+                raise KeyError(f"unknown tenant {name!r}")
+            self._assign[int(ep_id)] = t.tid
+            self.revision += 1
+
+    def unassign(self, ep_id: int) -> None:
+        with self._lock:
+            if self._assign.pop(int(ep_id), None) is not None:
+                self.revision += 1
+
+    # -- lookups -------------------------------------------------------------
+    def name_of(self, tid: int) -> str:
+        with self._lock:
+            t = self._by_id.get(tid)
+            return t.name if t is not None else TENANT_DEFAULT_NAME
+
+    def weight_of(self, tid: int) -> float:
+        with self._lock:
+            t = self._by_id.get(tid)
+            return t.weight if t is not None else 1.0
+
+    def cap_of(self, tid: int) -> int:
+        with self._lock:
+            t = self._by_id.get(tid)
+            return t.cap if t is not None else self._default_cap
+
+    def is_lane(self, tid: int) -> bool:
+        with self._lock:
+            t = self._by_id.get(tid)
+            return bool(t is not None and t.lane)
+
+    def tenants(self) -> Dict[int, str]:
+        with self._lock:
+            return {tid: t.name for tid, t in self._by_id.items()}
+
+    def tenant_of_ep(self, ep_id: int) -> int:
+        with self._lock:
+            return self._assign.get(int(ep_id), TENANT_DEFAULT)
+
+    # -- compiled LUT (feeder hot path) --------------------------------------
+    def lut(self) -> Optional[np.ndarray]:
+        """Dense ``ep_id -> tid`` int32 LUT, rebuilt only when the table
+        revision moved (the feeder calls this per poll batch)."""
+        with self._lock:
+            if self._lut_rev == self.revision:
+                return self._lut
+            if self._assign:
+                hi = max(self._assign)
+                if hi < DENSE_LUT_MAX:
+                    lut = np.zeros((hi + 1,), dtype=np.int32)
+                    for ep_id, tid in self._assign.items():
+                        lut[ep_id] = tid
+                    self._lut = lut
+                else:
+                    self._lut = None     # sparse world: dict fallback
+            else:
+                self._lut = None
+            self._lut_rev = self.revision
+            return self._lut
+
+    def map_tenants(self, ep_ids: np.ndarray) -> np.ndarray:
+        """Vectorized ``ep_id -> tid`` (unknown/negative → default).
+        Fail-open to tenant 0 — an unmapped endpoint must still be
+        served, it just rides the default budget."""
+        raw = np.asarray(ep_ids)
+        out = np.zeros(raw.shape, dtype=np.int32)
+        lut = self.lut()
+        if lut is not None:
+            ok = (raw >= 0) & (raw < lut.shape[0])
+            out[ok] = lut[raw[ok].astype(np.int64)]
+        else:
+            with self._lock:
+                assign = dict(self._assign)
+            if assign:
+                for ep_id, tid in assign.items():
+                    out[raw == ep_id] = tid
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "revision": self.revision,
+                "tenants": {
+                    t.name: {"tid": t.tid, "weight": t.weight,
+                             "lane": t.lane, "cap": t.cap}
+                    for t in self._by_id.values()},
+                "assigned_endpoints": len(self._assign),
+            }

@@ -207,6 +207,9 @@ def status_doc(engine: "Engine") -> Dict:
         "feeder": engine.feeder_stats(),
         # None until the overload controller has observed an interval
         "overload": engine.overload_status(),
+        # None unless multi-tenant QoS is armed (qos_enabled): tenant
+        # table + live per-tenant admission queue depths/admitted shares
+        "qos": engine.qos_status(),
         # None until the autotune controller has run against a pipeline
         "autotune": engine.autotune_status(),
         "trace": engine.tracer.stats(),

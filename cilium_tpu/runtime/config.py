@@ -215,6 +215,25 @@ class DaemonConfig:
     # burn threshold for ingest_e2e_slo_burn_total (+{shard=...}); 0 keeps
     # the e2e histograms exporting but disables burn counting
     slo_e2e_ms: float = 0.0
+    # --- multi-tenant QoS (cilium_tpu/qos; weighted-fair admission) ---
+    # default-off: with qos_enabled=False the admission queue is the
+    # plain FIFO deque, byte-identical to the pre-QoS pipeline. Armed,
+    # the feeder stamps a per-row tenant id (endpoint → tenant LUT), the
+    # admission queue goes per-tenant deficit-round-robin (weights from
+    # qos_tenants), and lane-tagged tenants bypass deadline microbatching
+    # at the qos_lane_bucket dispatch shape.
+    qos_enabled: bool = False
+    # tenant spec: "name=weight[:lane][:cap=N],..." — e.g.
+    # "gold=4:lane,silver=2,bulk=1:cap=8" (cap in queue batches)
+    qos_tenants: str = ""
+    # static endpoint→tenant assignment: "ep_id=tenant,..." (dynamic
+    # assignment goes through Engine.qos.assign at runtime)
+    qos_assign: str = ""
+    qos_default_weight: float = 1.0  # weight of the default tenant
+    qos_lane_bucket: int = 64        # latency-lane dispatch shape (pow2)
+    # per-tenant queue occupancy cap in batches for tenants without an
+    # explicit :cap= (0 = uncapped; the global queue bound still applies)
+    qos_tenant_cap_batches: int = 0
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:
@@ -348,6 +367,21 @@ class DaemonConfig:
                 or self.cluster_staleness_budget_s <= 0:
             raise ValueError("cluster_stale_after_s and "
                              "cluster_staleness_budget_s must be > 0")
+        if self.qos_lane_bucket <= 0 \
+                or self.qos_lane_bucket & (self.qos_lane_bucket - 1):
+            raise ValueError("qos_lane_bucket must be a power of two")
+        if self.qos_default_weight < 0:
+            raise ValueError("qos_default_weight must be >= 0")
+        if self.qos_tenant_cap_batches < 0:
+            raise ValueError("qos_tenant_cap_batches must be >= 0 "
+                             "(0 = uncapped)")
+        if self.qos_enabled or self.qos_tenants or self.qos_assign:
+            # parse eagerly so a malformed spec fails at config load, not
+            # mid-flood inside the admission path
+            from cilium_tpu.qos.tenancy import (parse_assign_spec,
+                                                parse_tenant_spec)
+            parse_tenant_spec(self.qos_tenants)
+            parse_assign_spec(self.qos_assign)
 
     # -- sources -------------------------------------------------------------
     @classmethod

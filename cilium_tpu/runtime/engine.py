@@ -151,6 +151,20 @@ class Engine:
             event_sink=self.blackbox.record_event)
         self._last_update_stats = None   # incremental UpdateStats (budgets)
         self._hbm_budget = None          # attached verifier budget_doc
+        # multi-tenant QoS (cilium_tpu/qos): the tenant table is built
+        # once from config and threaded into the pipeline (weighted-fair
+        # admission + latency lane) and the feeder (harvest-time tenant
+        # stamping). None when qos_enabled is off — every consumer then
+        # takes its pre-QoS FIFO path, byte-identical to today.
+        if self.config.qos_enabled:
+            from cilium_tpu.qos import TenantTable
+            self.qos = TenantTable.from_spec(
+                self.config.qos_tenants,
+                assign=self.config.qos_assign,
+                default_weight=self.config.qos_default_weight,
+                default_cap=self.config.qos_tenant_cap_batches)
+        else:
+            self.qos = None
         self._register_resources()
         self.controllers = ControllerManager()
 
@@ -708,7 +722,16 @@ class Engine:
                     if shards > 1 else None,
                     mesh_shards=mesh_shards,
                     rss_mode=rss_mode,
-                    event_sink=self._pipeline_event)
+                    event_sink=self._pipeline_event,
+                    qos=self.qos,
+                    # the lane shape must stay a valid bucket: within
+                    # [1, min_bucket] and, on a device-RSS mesh, still
+                    # divisible across the flow axis (>= mesh_shards)
+                    lane_bucket=min(max(cfg.qos_lane_bucket,
+                                        mesh_shards
+                                        if rss_mode == "device" else 1),
+                                    min_bucket)
+                    if self.qos is not None else 0)
             return self._pipeline
 
     def submit(self, batch: Dict[str, np.ndarray],
@@ -870,7 +893,10 @@ class Engine:
                 metrics=self.metrics, tracer=self.tracer,
                 # SHED-NEW harvest drops narrate to the flight recorder
                 # (the relaxed shed-spike class) like pipeline sheds do
-                event_sink=self._pipeline_event).start()
+                event_sink=self._pipeline_event,
+                # QoS armed: harvest stamps the per-row tenant id the
+                # admission queue's weighted-fair scheduling keys on
+                qos=self.qos).start()
             return self._feeder
 
     def feeder_stats(self) -> Optional[Dict]:
@@ -1028,7 +1054,18 @@ class Engine:
         # the ledger's fourth latch: worst NON-CT failure-class pressure
         # (CT and the admission queue are already the ladder's own
         # signals; graceful-degradation pools report pressure 0 anyway)
-        res_p = self.ledger.max_pressure(exclude=LADDER_EXCLUDE)
+        exclude = LADDER_EXCLUDE
+        if self.qos is not None:
+            # per-tenant queue rows must not light the GLOBAL ladder: one
+            # tenant hitting its own cap is isolation working as designed
+            # (the aggregate admission_queue signal already covers real
+            # queue pressure) — tenant-scoped relief happens at the
+            # admission sites (over_share fail-fast, pressure-ordered
+            # victim selection), not on the cluster-wide rung
+            exclude = tuple(LADDER_EXCLUDE) + tuple(
+                f"qos_tenant_queue_{n}"
+                for n in self.qos.tenants().values())
+        res_p = self.ledger.max_pressure(exclude=exclude)
         state, changed = self._overload.observe(queue_frac, rate, ct_occ,
                                                 resource_pressure=res_p)
         if pl is not None:
@@ -1054,6 +1091,23 @@ class Engine:
         ov = self._overload
         return ov.status() if ov is not None else None
 
+    def qos_status(self) -> Optional[Dict]:
+        """The multi-tenant QoS document (``/v1/status`` row): tenant
+        table (weights/lanes/caps/assignments) plus the live per-tenant
+        admission picture when the pipeline is up. None when QoS is off —
+        the status document stays byte-identical to the pre-QoS shape."""
+        if self.qos is None:
+            return None
+        doc: Dict = dict(self.qos.stats())
+        pl = self._pipeline
+        if pl is not None:
+            ps = pl.stats()
+            doc["tenants"] = ps.get("tenants", {})
+            doc["lane_bucket"] = ps.get("lane_bucket", 0)
+            doc["lane_fill_rows"] = ps.get("lane_fill_rows", 0)
+            doc["lane_bucket_rows"] = ps.get("lane_bucket_rows", 0)
+        return doc
+
     # -- resource pressure ledger (observe/pressure.py; ISSUE 13) --------------
     # Provider contract: each returns {resource: (capacity, occupancy)} or
     # (capacity, occupancy, pressure) — the 3-tuple hands through a
@@ -1067,6 +1121,8 @@ class Engine:
         self.ledger.register("ct", self._res_ct)
         self.ledger.register("pipeline", self._res_pipeline)
         self.ledger.register("feeder", self._res_feeder)
+        if self.qos is not None:
+            self.ledger.register("qos", self._res_qos)
         self.ledger.register("compile", self._res_compile)
         self.ledger.register("observe", self._res_observe)
         self.ledger.register("datapath", self._res_datapath)
@@ -1114,6 +1170,27 @@ class Engine:
         # design) — informational occupancy, not failure pressure
         return {"feeder_pool": (self.config.ingest_pool_batches,
                                 st.get("pending", 0), 0.0)}
+
+    def _res_qos(self) -> Dict:
+        # per-tenant admission-queue rows (active tenants only): a tenant
+        # that drains/departs stops reporting and the ledger's staleness
+        # sweep drops its whole gauge family — the departed-subject
+        # discipline (a frozen depth for a gone tenant reads as load).
+        # Capped tenants carry real pressure (cap exhaustion sheds, the
+        # tenant_cap class); uncapped tenants report informational 0.0 —
+        # their bound is the global queue, already a ladder signal.
+        pl = self._pipeline
+        if pl is None or self.qos is None:
+            return {}
+        out: Dict = {}
+        for name, (cap, depth) in \
+                pl.occupancy_stats().get("tenants", {}).items():
+            if cap > 0:
+                out[f"qos_tenant_queue_{name}"] = (cap, depth)
+            else:
+                out[f"qos_tenant_queue_{name}"] = (
+                    self.config.pipeline_queue_batches, depth, 0.0)
+        return out
 
     def _res_compile(self) -> Dict:
         from cilium_tpu.policy.mapstate import overlay_stats

@@ -136,6 +136,7 @@ class ShimFeeder:
                  metrics: Optional[Metrics] = None,
                  tracer: Optional[Tracer] = None,
                  event_sink=None,
+                 qos=None,
                  name: str = "feeder"):
         if not 1 <= pool_batches <= MAX_UNVERDICTED_BATCHES:
             raise ValueError(
@@ -177,6 +178,17 @@ class ShimFeeder:
                                    dtype=np.int8)
         self._est_filter = np.zeros((EST_FILTER_SLOTS,), dtype=np.uint32)
         self._est_mask = np.uint32(EST_FILTER_SLOTS - 1)
+        # multi-tenant QoS (cilium_tpu/qos): with a TenantTable armed,
+        # every poll buffer carries a ``_tenant`` column stamped at
+        # harvest time from the endpoint→tenant LUT (same compiled-LUT
+        # discipline as the ep-slot map below) — the admission queue's
+        # weighted-fair scheduling and the per-tenant e2e/SLO families
+        # key on it. QoS off: no column, zero extra work per poll.
+        self._qos = qos
+        if qos is not None:
+            for buf in self._free:
+                buf["_tenant"] = np.zeros((shim.batch_size,),
+                                          dtype=np.int32)
         if n_shards > 1:
             # software RSS (SURVEY §2), HOST steering mode only: harvest
             # pre-bins each record by the direction-normalized flow hash
@@ -421,6 +433,14 @@ class ShimFeeder:
             pr = np.where(hit, PRIO_ESTABLISHED, PRIO_NEW).astype(np.int8)
             pr[unknown] = PRIO_UNKNOWN
             b["_prio"][:] = pr
+        if self._qos is not None and "_tenant" in b:
+            # tenant identity while the ep ids are hot: endpoint → tenant
+            # via the TenantTable's compiled LUT (cached on its revision
+            # counter inside map_tenants — same rebuild-on-change
+            # discipline as the ep-slot LUT above). Unknown endpoints
+            # land on the default tenant: they must still be served,
+            # they just ride the shared budget.
+            b["_tenant"][:] = self._qos.map_tenants(b["_ep_raw"])
         if self._n_shards > 1:
             # pre-bin while the columns are already hot in cache: the same
             # direction-normalized hash (post-DNAT tuple) the datapath and
@@ -570,11 +590,27 @@ class ShimFeeder:
                     self.metrics.histogram(
                         f'ingest_e2e_latency_seconds{{shard="{int(s)}"}}'
                     ).observe(lat_s)
+            tnames = ()
+            if self._qos is not None and "_tenant" in buf:
+                # per-tenant SLO accounting, batch-granular like the
+                # shard families: the batch's latency is observed once
+                # into each tenant family that had valid rows — the
+                # per-tenant p99 the isolation contract is gated on
+                tids = np.unique(
+                    np.asarray(buf["_tenant"])[np.asarray(buf["valid"])])
+                tnames = [self._qos.name_of(int(t)) for t in tids]
+                for tn in tnames:
+                    self.metrics.histogram(
+                        f'ingest_e2e_latency_seconds{{tenant="{tn}"}}'
+                    ).observe(lat_s)
             if self._slo_s and lat_s > self._slo_s:
                 self.metrics.inc_counter("ingest_e2e_slo_burn_total")
                 for s in shards:
                     self.metrics.inc_counter(
                         f'ingest_e2e_slo_burn_total{{shard="{int(s)}"}}')
+                for tn in tnames:
+                    self.metrics.inc_counter(
+                        f'ingest_e2e_slo_burn_total{{tenant="{tn}"}}')
                 self.slo_burns += 1
         except Exception:   # noqa: BLE001
             log.exception("e2e latency observation failed")
