@@ -186,6 +186,19 @@ class Autotuner:
             if new_b != old_b:
                 pl.set_min_bucket(new_b)
                 self._decide(obs, "min_bucket", old_b, new_b)
+                lane = getattr(pl, "lane_bucket", 0)
+                if lane > new_b:
+                    # the "lane_bucket never exceeds min_bucket" invariant
+                    # is enforced HERE, not left to the lane arm — its own
+                    # shrink path needs `hysteresis` consecutive lane
+                    # signals pointing down, which may never come, and the
+                    # lane would dispatch above the bulk floor for many
+                    # intervals meanwhile (largest power of two <= the new
+                    # floor, since the lane shape must stay pow2)
+                    clamped = 1 << (new_b.bit_length() - 1)
+                    pl.set_lane_bucket(clamped)
+                    self._decide(obs, "lane_bucket", lane, clamped)
+                    self._lane_streak = 0
             self._bucket_streak = 0
 
         # -- lane_bucket (latency-lane floor; QoS only — zero disarms) -------

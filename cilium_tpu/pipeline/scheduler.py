@@ -637,108 +637,140 @@ class Pipeline:
                 tenant = 0
             ticket.tenant = self._qos.name_of(tenant)
         victim: Optional[_Sub] = None
-        with self._lock:
-            if self._closing or self._closed:
-                raise PipelineClosed("pipeline is closed")
-            if self._failed:
-                # re-check under the lock: a hard-fail landing between the
-                # unlocked check above and here must not enqueue a ticket
-                # nothing will ever serve
-                self._count_unavailable_locked()
-                raise PipelineUnavailable(
-                    f"pipeline hard-failed after {self._restarts} worker "
-                    "restarts; no new submissions")
-            qs = self._queue if self._qos is not None else None
-            while True:
-                qfull = len(self._queue) >= self._queue_max
-                # per-tenant occupancy cap (QoS only): the tenant is at
-                # its OWN budget even if the shared queue has room — it
-                # waits/sheds against that budget, never spending the
-                # other tenants' headroom
-                tcap = qs is not None and qs.over_cap(tenant)
-                if not qfull and not tcap:
-                    break
-                if qfull and self._overload_level >= OVERLOAD_PRESSURE \
-                        and victim is None:
-                    # priority shedding (the degradation ladder's PRESSURE
-                    # behavior): a full queue sheds its WORST-ranked
-                    # submission in favor of a better-ranked newcomer —
-                    # established-flow batches displace flood batches
-                    # instead of queueing behind them. Same-class traffic
-                    # keeps the plain FIFO admission below. With QoS armed
-                    # the scan is tenant-scoped: the worst-PRESSURE tenant
-                    # (queue depth over weight) sheds first, and within
-                    # the submitter's own tenant the old strictly-worse-
-                    # class contract still holds.
-                    victim = (self._queue.priority_victim(prio, tenant)
-                              if qs is not None
-                              else self._priority_victim_locked(prio))
-                    if victim is not None:
-                        self._queue.remove(victim)
-                        self.metrics.set_gauge("pipeline_queue_depth",
-                                               len(self._queue))
-                        if qs is None or not qs.over_cap(tenant):
-                            break
-                remaining = deadline - time.monotonic()
-                # OVERLOAD fail-fast is tenant-scoped under QoS: only a
-                # tenant at-or-over its weight share of the queue is
-                # instant-rejected; a within-budget tenant still gets the
-                # blocking wait (its backlog is someone else's flood)
-                fail_fast = self._overload_level >= OVERLOAD_OVERLOAD \
-                    and (qs is None or qs.over_share(tenant))
-                if self._admission == "drop" or remaining <= 0 or fail_fast:
-                    if tcap and not qfull:
-                        # the tenant's own cap is the binding constraint:
-                        # this is a shed against its private budget, not a
-                        # shared-queue admission drop
-                        self.shed_total += 1
-                        self.shed_reasons["tenant_cap"] = \
-                            self.shed_reasons.get("tenant_cap", 0) + 1
-                        self.metrics.inc_counter(
-                            f'pipeline_shed_total{{reason="tenant_cap",'
-                            f'tenant="{ticket.tenant}"}}')
-                        ticket._reject(PipelineTenantCap(
-                            f"tenant {ticket.tenant!r} at its occupancy "
-                            f"cap ({qs.table.cap_of(tenant)} batches); "
-                            f"admission={self._admission}"))
-                        return ticket
-                    self.admission_drops += 1
-                    self.metrics.inc_counter(
-                        "pipeline_admission_drops_total"
-                        if ticket.tenant is None else
-                        f'pipeline_admission_drops_total'
-                        f'{{tenant="{ticket.tenant}"}}')
-                    ticket._reject(PipelineDrop(
-                        f"queue full ({self._queue_max} batches); "
-                        f"admission={self._admission}"
-                        + (", overload fail-fast" if fail_fast else "")))
-                    return ticket
-                self._cond.wait(min(remaining, 0.05))
+        try:
+            with self._lock:
                 if self._closing or self._closed:
-                    raise PipelineClosed("pipeline closed while blocked "
-                                         "at admission")
+                    raise PipelineClosed("pipeline is closed")
                 if self._failed:
-                    # hard-fail swept the queue out from under us; the
-                    # freed capacity must not admit work nothing will serve
+                    # re-check under the lock: a hard-fail landing between
+                    # the unlocked check above and here must not enqueue a
+                    # ticket nothing will ever serve
                     self._count_unavailable_locked()
                     raise PipelineUnavailable(
-                        "pipeline hard-failed while blocked at admission")
-            ticket.seq = self._next_seq
-            self._next_seq += 1
-            self._queue.append(_Sub(ticket, batch, now, prio=prio,
-                                    tenant=tenant))
-            self.submitted += 1
-            self._outstanding += 1
-            self.metrics.set_gauge("pipeline_queue_depth", len(self._queue))
-            self._cond.notify_all()
-        if victim is not None:
-            # settle OUTSIDE the lock (_shed takes it); the victim is out
-            # of the queue and settles here unconditionally — a racing
-            # sweep dedupes through ticket.done()
-            self._shed(victim.ticket, "priority", PipelineDrop(
-                f"priority shed: displaced by a class-{prio} submission "
-                f"under overload state {self._overload_level} "
-                f"(seq={victim.ticket.seq}, class={victim.prio})"))
+                        f"pipeline hard-failed after {self._restarts} "
+                        "worker restarts; no new submissions")
+                qs = self._queue if self._qos is not None else None
+                while True:
+                    qfull = len(self._queue) >= self._queue_max
+                    # per-tenant occupancy cap (QoS only): the tenant is
+                    # at its OWN budget even if the shared queue has room
+                    # — it waits/sheds against that budget, never spending
+                    # the other tenants' headroom
+                    tcap = qs is not None and qs.over_cap(tenant)
+                    if not qfull and not tcap:
+                        break
+                    if qfull and not tcap and victim is None \
+                            and self._overload_level >= OVERLOAD_PRESSURE:
+                        # priority shedding (the degradation ladder's
+                        # PRESSURE behavior): a full queue sheds its
+                        # WORST-ranked submission in favor of a
+                        # better-ranked newcomer — established-flow
+                        # batches displace flood batches instead of
+                        # queueing behind them. Same-class traffic keeps
+                        # the plain FIFO admission below. With QoS armed
+                        # the scan is tenant-scoped: the worst-PRESSURE
+                        # tenant (queue depth over weight) sheds first,
+                        # and within the submitter's own tenant the old
+                        # strictly-worse-class contract still holds. The
+                        # scan is gated on `not tcap`: a submitter at its
+                        # own cap gains nothing from displacing someone
+                        # else, so no victim is removed it cannot use —
+                        # and once one IS removed we break unconditionally
+                        # (the lock is held throughout, so the just-
+                        # checked cap cannot have changed) straight to
+                        # the enqueue below: no loop exit can strand an
+                        # already-removed victim.
+                        victim = (self._queue.priority_victim(prio, tenant)
+                                  if qs is not None
+                                  else self._priority_victim_locked(prio))
+                        if victim is not None:
+                            self._queue.remove(victim)
+                            self.metrics.set_gauge("pipeline_queue_depth",
+                                                   len(self._queue))
+                            break
+                    remaining = deadline - time.monotonic()
+                    # OVERLOAD fail-fast is tenant-scoped under QoS: only
+                    # a tenant at-or-over its weight share of the queue is
+                    # instant-rejected; a within-budget tenant still gets
+                    # the blocking wait (its backlog is someone else's
+                    # flood)
+                    fail_fast = self._overload_level >= OVERLOAD_OVERLOAD \
+                        and (qs is None or qs.over_share(tenant))
+                    if self._admission == "drop" or remaining <= 0 \
+                            or fail_fast:
+                        if tcap and not qfull:
+                            # the tenant's own cap is the binding
+                            # constraint: this is a shed against its
+                            # private budget, not a shared-queue
+                            # admission drop
+                            self.shed_total += 1
+                            self.shed_reasons["tenant_cap"] = \
+                                self.shed_reasons.get("tenant_cap", 0) + 1
+                            self.metrics.inc_counter(
+                                'pipeline_shed_total'
+                                '{reason="tenant_cap"}')
+                            self.metrics.inc_counter(
+                                f'pipeline_shed_total{{reason="tenant_cap"'
+                                f',tenant="{ticket.tenant}"}}')
+                            ticket._reject(PipelineTenantCap(
+                                f"tenant {ticket.tenant!r} at its "
+                                f"occupancy cap "
+                                f"({qs.table.cap_of(tenant)} batches); "
+                                f"admission={self._admission}"))
+                            return ticket
+                        self.admission_drops += 1
+                        # the unlabeled family counts EVERY drop — QoS on
+                        # or off — so pre-QoS dashboards/alerts keep
+                        # working when QoS is armed; the tenant-labeled
+                        # family rides alongside it (the shard-metrics
+                        # discipline), never instead of it
+                        self.metrics.inc_counter(
+                            "pipeline_admission_drops_total")
+                        if ticket.tenant is not None:
+                            self.metrics.inc_counter(
+                                f'pipeline_admission_drops_total'
+                                f'{{tenant="{ticket.tenant}"}}')
+                        ticket._reject(PipelineDrop(
+                            f"queue full ({self._queue_max} batches); "
+                            f"admission={self._admission}"
+                            + (", overload fail-fast"
+                               if fail_fast else "")))
+                        return ticket
+                    self._cond.wait(min(remaining, 0.05))
+                    if self._closing or self._closed:
+                        raise PipelineClosed("pipeline closed while "
+                                             "blocked at admission")
+                    if self._failed:
+                        # hard-fail swept the queue out from under us; the
+                        # freed capacity must not admit work nothing will
+                        # serve
+                        self._count_unavailable_locked()
+                        raise PipelineUnavailable(
+                            "pipeline hard-failed while blocked at "
+                            "admission")
+                ticket.seq = self._next_seq
+                self._next_seq += 1
+                self._queue.append(_Sub(ticket, batch, now, prio=prio,
+                                        tenant=tenant))
+                self.submitted += 1
+                self._outstanding += 1
+                self.metrics.set_gauge("pipeline_queue_depth",
+                                       len(self._queue))
+                self._cond.notify_all()
+        finally:
+            if victim is not None:
+                # settle OUTSIDE the lock (_shed takes it; the `with`
+                # block has exited by the time `finally` runs). A removed
+                # victim settles on EVERY exit path — the normal enqueue,
+                # the reject returns, and the closed/hard-fail raises —
+                # or its producer would block forever on a ticket nothing
+                # owns and _outstanding would never drain. A racing sweep
+                # dedupes through ticket.done().
+                self._shed(victim.ticket, "priority", PipelineDrop(
+                    f"priority shed: displaced by a class-{prio} "
+                    f"submission under overload state "
+                    f"{self._overload_level} "
+                    f"(seq={victim.ticket.seq}, class={victim.prio})"))
         return ticket
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -1275,14 +1307,17 @@ class Pipeline:
         with self._lock:
             self.shed_total += 1
             self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
-        # QoS armed: the shed is attributed to the ticket's tenant (the
-        # name rode the ticket from admission, no table lookup here);
-        # QoS off keeps the exact pre-QoS family
+        # the reason-only family counts every shed, QoS on or off, so
+        # pre-QoS dashboards/alerts keep working when QoS is armed; with
+        # QoS the shed is ALSO attributed to the ticket's tenant (the
+        # name rode the ticket from admission, no table lookup here) in a
+        # labeled family alongside it, never instead of it
         self.metrics.inc_counter(
-            f'pipeline_shed_total{{reason="{reason}"}}'
-            if ticket.tenant is None else
-            f'pipeline_shed_total{{reason="{reason}",'
-            f'tenant="{ticket.tenant}"}}')
+            f'pipeline_shed_total{{reason="{reason}"}}')
+        if ticket.tenant is not None:
+            self.metrics.inc_counter(
+                f'pipeline_shed_total{{reason="{reason}",'
+                f'tenant="{ticket.tenant}"}}')
         self.tracer.record(ticket.trace_id, "pipeline.shed",
                            ticket.submitted_mono,
                            time.monotonic() - ticket.submitted_mono,

@@ -17,6 +17,7 @@ consults is a leaf lock).
 
 from __future__ import annotations
 
+import math
 from collections import deque
 from typing import Dict, Iterator, Optional, Tuple
 
@@ -101,9 +102,13 @@ class TenantQueues:
 
         Otherwise standard DRR: serve the head of the active ring while
         its deficit covers the head batch's row cost; top up one quantum
-        and rotate when spent. Guaranteed to terminate — every full
-        rotation adds at least :data:`WEIGHT_FLOOR_ROWS` to each queued
-        tenant."""
+        and rotate when spent. Guaranteed to terminate in O(tenants) loop
+        iterations — a full rotation in which nobody could afford its
+        head fast-forwards the number of empty rounds the cheapest
+        unblock needs in one step, instead of rotating once per
+        :data:`WEIGHT_FLOOR_ROWS` row (a zero-weight tenant's big batch
+        would otherwise cost hundreds of ring spins under the pipeline
+        lock)."""
         if not self._len:
             raise IndexError("pop from an empty TenantQueues")
         if self.lane_rows:
@@ -118,6 +123,7 @@ class TenantQueues:
                     self._lane_debt[tid] = \
                         self._lane_debt.get(tid, 0.0) + cost
                     return self._served(tid, sub, q, cost)
+        spins = 0
         while True:
             tid = self._order[0]
             q = self._queues.get(tid)
@@ -140,6 +146,12 @@ class TenantQueues:
                 # accrued deficit and earns a fresh quantum next round)
                 self._granted.discard(tid)
                 self._order.rotate(-1)
+                spins += 1
+                if spins >= len(self._order):
+                    # a whole rotation granted everyone a quantum and
+                    # served nobody: replay the empty rounds in bulk
+                    self._fast_forward()
+                    spins = 0
                 continue
             sub = q.popleft()
             self._deficit[tid] -= cost
@@ -177,6 +189,33 @@ class TenantQueues:
         return max(float(WEIGHT_FLOOR_ROWS),
                    self.table.weight_of(tid) * self._qrows)
 
+    def _fast_forward(self) -> None:
+        """Credit every queued tenant the smallest whole number of DRR
+        rounds after which at least one of them can afford its head
+        batch — the deterministic equivalent of that many empty ring
+        rotations (each round's grant still pays lane debt before
+        banking deficit), collapsed into one O(tenants) pass."""
+        rounds: Optional[int] = None
+        for tid in self._order:
+            q = self._queues.get(tid)
+            if not q:
+                continue
+            need = (max(1, q[0].ticket.n_valid)
+                    + self._lane_debt.get(tid, 0.0) - self._deficit[tid])
+            k = max(1, int(math.ceil(need / self._quantum(tid))))
+            rounds = k if rounds is None else min(rounds, k)
+        if not rounds:
+            return
+        for tid in self._order:
+            if not self._queues.get(tid):
+                continue
+            total = rounds * self._quantum(tid)
+            debt = self._lane_debt.get(tid, 0.0)
+            pay = min(total, debt)
+            if pay:
+                self._lane_debt[tid] = debt - pay
+            self._deficit[tid] += total - pay
+
     def _retire_locked(self, tid: int) -> None:
         try:
             self._order.remove(tid)
@@ -185,9 +224,23 @@ class TenantQueues:
         self._queues.pop(tid, None)
         self._deficit.pop(tid, None)
         self._granted.discard(tid)
-        # an idle tenant's lane debt is forgiven with its credit —
-        # symmetric with "idle tenants bank no credit"
-        self._lane_debt.pop(tid, None)
+        # lane debt SURVIVES per-tenant retirement, unlike credit: a lane
+        # tenant whose queue drains on every pop (arrival rate ~ service
+        # rate, one batch queued at a time) retires here after every
+        # single popleft, and forgiving the debt with the credit would
+        # reset the "bypass only while debt < one quantum" starvation
+        # bound each time — the ring would never get a turn. The debt is
+        # owed TO the tenants still queued behind the bypass, though, so
+        # when the LAST queue drains the creditors no longer exist and
+        # all debt is forgiven (otherwise debt banked against an idle
+        # ring — e.g. sparse probes on an unloaded system — would deny
+        # the bypass at the start of the next busy period and show up as
+        # a lane-latency spike that repays nobody). Zeroed entries are
+        # dropped so a departed tenant does not leak a dict slot.
+        if self._len == 0:
+            self._lane_debt.clear()
+        elif not self._lane_debt.get(tid):
+            self._lane_debt.pop(tid, None)
 
     # -- admission policy (scheduler hooks) ----------------------------------
     def occupancy(self, tid: int) -> int:

@@ -148,6 +148,7 @@ class FlowShim:
         # would desync frames from verdicts; see apply_verdicts)
         self._pending_counts: list = []
         self._enforcing = False        # mirrors flowshim.cc Shim::enforcing
+        self._rings_ready = False      # set by afxdp_bind/mock_rings_init
 
     def close(self):
         if self._handle:
@@ -288,7 +289,19 @@ class FlowShim:
             self._handle, ctypes.byref(self._rec_buf[rec_index]), n_shards)
 
     def afxdp_bind(self, ifname: str, queue: int = 0) -> int:
-        return self._lib.shim_afxdp_bind(self._handle, ifname.encode(), queue)
+        rc = self._lib.shim_afxdp_bind(self._handle, ifname.encode(), queue)
+        if rc == 0:
+            self._rings_ready = True
+        return rc
+
+    @property
+    def rings_ready(self) -> bool:
+        """Whether rx/fill rings exist (afxdp_bind or mock_rings_init
+        succeeded). Python-side truth, deliberately NOT derived from the
+        fill LEVEL: with every umem descriptor parked in the rx ring the
+        level legitimately reads zero — exactly the state where the ring
+        drain is most needed."""
+        return self._rings_ready
 
     # -- ring path (kernel-mapped after afxdp_bind; heap-mocked for tests) --
     def afxdp_poll(self, budget: int = 256, now_us: int = 0) -> int:
@@ -307,6 +320,7 @@ class FlowShim:
                                             frame_size, n_frames)
         if rc != 0:
             raise OSError(-rc, "shim_mock_rings_init failed")
+        self._rings_ready = True
 
     def mock_rx_inject(self, frame: bytes) -> int:
         """Act as the NIC: fill-ring frame ← frame bytes → rx descriptor."""

@@ -391,13 +391,21 @@ class ShimFeeder:
 
     def _rings_attached(self) -> bool:
         """Whether rx/fill rings exist (AF_XDP bind or mocked); without
-        them the plain feed_frame→batcher path needs no ring drain. Only a
-        positive probe is cached: a transient zero fill level (every umem
-        descriptor in flight, or rings initialized after start) must not
-        permanently disable the ring drain."""
+        them the plain feed_frame→batcher path needs no ring drain.
+        Prefer the shim's own ``rings_ready`` flag: probing the fill
+        LEVEL can read zero with every umem descriptor parked in the rx
+        ring — exactly the state where the drain is most needed — and
+        since only the drain recycles addresses, mistaking that for "no
+        rings" wedges ingestion permanently (the producer sees a full rx
+        ring, the harvester never looks at it). The level probe remains
+        as a fallback for shim stand-ins without the flag; only a
+        positive probe is cached, so rings initialized after start still
+        attach."""
         if self._rings:
             return True
-        self._rings = self.shim.ring_fill_level() > 0
+        ready = getattr(self.shim, "rings_ready", None)
+        self._rings = bool(ready) if ready is not None \
+            else self.shim.ring_fill_level() > 0
         return bool(self._rings)
 
     #: class-level alias (tests monkeypatch it to force the sparse path)

@@ -169,6 +169,27 @@ class TestFeederEndToEnd:
         assert stats["rejected_batches"] == 0
         shim.close()
 
+    def test_rings_attach_with_exhausted_fill_ring(self):
+        """Every umem descriptor parked in the rx ring BEFORE the
+        feeder's first ring probe: the fill level reads zero exactly
+        when the ring drain is most needed, and only the drain recycles
+        addresses — a probe that mistook that for "no rings" deadlocked
+        ingestion permanently (producer: full rx ring; harvester: never
+        looks). The same race fired intermittently when a fast producer
+        out-injected the feeder thread's startup."""
+        eng = fake_engine()
+        shim = mk_shim()                      # ring 64 / 64 umem frames
+        frames = [build_frame("192.168.1.10", "10.1.2.3", 47000 + i, 443)
+                  for i in range(64)]
+        for f in frames:
+            assert shim.mock_rx_inject(f) == 0
+        assert shim.ring_fill_level() == 0    # the trap state
+        eng.start_feeder(shim)
+        st = wait_verdicts(shim, 64)
+        eng.stop()
+        assert st["verdict_passes"] == 64
+        shim.close()
+
     def test_rx_ring_faults_tolerated(self):
         """An armed shim.rx_ring fault storm fails individual polls; the
         frames stay queued and every verdict still lands FIFO."""

@@ -491,7 +491,23 @@ class TestAutotuner:
         assert pl.min_bucket < 1024
         assert pl.min_bucket >= 64          # the configured floor holds
 
-    def test_bucket_floor_up_on_near_full(self):
+    def test_bucket_floor_shrink_clamps_lane_bucket(self):
+        """The "lane_bucket never exceeds min_bucket" invariant is
+        enforced the moment the bulk arm shrinks min_bucket — the lane
+        arm's own (hysteresis-gated) shrink path may take many intervals
+        to fire, or never, and the lane would dispatch above the bulk
+        floor meanwhile."""
+        m = Metrics()
+        pl = _StubPipeline(m, min_bucket=1024)
+        pl.lane_bucket = 1024                # at the ceiling
+        pl.set_lane_bucket = lambda v: setattr(pl, "lane_bucket", v)
+        at = mk_autotuner(pl, m)
+        for _ in range(8):
+            pl.interval(wait_ms=1.0, fill=0.3, reason="deadline")
+            at.step()
+        assert pl.min_bucket < 1024
+        assert pl.lane_bucket <= pl.min_bucket
+        assert any(a["knob"] == "lane_bucket" for a in at.adjustments)
         m = Metrics()
         pl = _StubPipeline(m, min_bucket=256)
         at = mk_autotuner(pl, m)

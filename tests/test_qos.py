@@ -259,6 +259,69 @@ class TestTenantQueues:
         assert got == ["g0", "g1", "g2", "g3"]       # bypass while affordable
         assert qs.popleft().tag == "s0"              # debt cap: ring resumes
 
+    def test_lane_debt_survives_queue_drain(self):
+        """A lane tenant that keeps exactly ONE batch queued at a time
+        (arrival rate ~ service rate) drains its queue — and is retired
+        from the ring — on every single pop. Its lane debt must survive
+        that retirement: forgiving it with the credit would reset the
+        "bypass only while debt < one quantum" starvation bound on every
+        popleft and the ring (bulk tenants) would be starved forever."""
+        tbl = TenantTable.from_spec(SPEC)
+        tids = {v: k for k, v in tbl.tenants().items()}
+        qs = TenantQueues(tbl, quantum_rows=4, lane_rows=8)
+        for i in range(6):
+            qs.append(_FakeSub(tids["bulk"], n_valid=4, tag=f"b{i}"))
+        got = []
+        for i in range(5):
+            qs.append(_FakeSub(tids["gold"], n_valid=4, tag=f"g{i}"))
+            got.append(qs.popleft().tag)
+        # gold's quantum is 4*4=16 rows: four bypassed 4-row pops bank a
+        # full quantum of debt even though gold's queue drained after
+        # each one — the 5th pop falls back to the ring and bulk is
+        # finally served
+        assert got == ["g0", "g1", "g2", "g3", "b0"]
+        assert qs.popleft().tag == "b1"   # ring grant pays the debt down
+        assert qs.popleft().tag == "g4"   # ...and the bypass re-arms
+
+    def test_lane_debt_forgiven_when_ring_fully_drains(self):
+        """Lane debt is owed to the tenants queued behind the bypass —
+        when the LAST queue drains there is nobody left to repay, and
+        carrying the debt into the next busy period would deny the lane
+        bypass to the first probes after an idle gap (a latency spike
+        that repays no one). Debt banked by sparse probes on an idle
+        ring must NOT outlive a full drain."""
+        tbl = TenantTable.from_spec(SPEC)
+        tids = {v: k for k, v in tbl.tenants().items()}
+        qs = TenantQueues(tbl, quantum_rows=4, lane_rows=8)
+        # unloaded phase: sparse gold probes, one at a time, bank a full
+        # quantum (4 * 4 rows >= quantum 16) of debt against an idle ring
+        for i in range(4):
+            qs.append(_FakeSub(tids["gold"], n_valid=4, tag=f"p{i}"))
+            assert qs.popleft().tag == f"p{i}"
+        assert len(qs) == 0               # ring fully drained -> debt gone
+        # busy period starts: bulk floods, then a gold probe arrives —
+        # the bypass must be armed (with stale debt it would queue
+        # behind both bulk batches)
+        qs.append(_FakeSub(tids["bulk"], n_valid=4, tag="b0"))
+        qs.append(_FakeSub(tids["bulk"], n_valid=4, tag="b1"))
+        qs.append(_FakeSub(tids["gold"], n_valid=4, tag="g0"))
+        assert qs.popleft().tag == "g0"
+
+    def test_zero_weight_big_batch_fast_forwards(self):
+        """Two zero-weight tenants with max-bucket-sized heads: the floor
+        quantum is 1 row, so reaching a 512-row head used to take 512
+        full ring rotations under the pipeline lock — the fruitless-
+        rotation fast-forward credits those rounds in one O(tenants)
+        pass, and service order is unchanged (first-enqueued first)."""
+        tbl = TenantTable.from_spec(SPEC)
+        za = tbl.register("za", weight=0.0)
+        zb = tbl.register("zb", weight=0.0)
+        qs = TenantQueues(tbl, quantum_rows=1)
+        qs.append(_FakeSub(za, n_valid=512, tag="a"))
+        qs.append(_FakeSub(zb, n_valid=512, tag="b"))
+        assert [qs.popleft().tag for _ in range(2)] == ["a", "b"]
+        assert len(qs) == 0
+
 
 # --------------------------------------------------------------------------- #
 # pipeline-level QoS (raw Pipeline against an echo dispatch)
@@ -367,6 +430,11 @@ class TestQosPipeline:
             assert not tg.dropped
             key = 'pipeline_shed_total{reason="tenant_cap",tenant="bulk"}'
             assert pl.metrics.counters.get(key) == 1
+            # the labeled family rides ALONGSIDE the pre-QoS reason-only
+            # family, never instead of it — dashboards watching the bare
+            # family must keep counting with QoS armed
+            assert pl.metrics.counters.get(
+                'pipeline_shed_total{reason="tenant_cap"}') == 1
             assert pl.shed_reasons.get("tenant_cap") == 1
             d.gate.set()
             assert pl.drain(timeout=10)
@@ -400,6 +468,44 @@ class TestQosPipeline:
             assert pl.drain(timeout=10)
             assert not q1.dropped
             tg.result(timeout=5)
+        finally:
+            pl.close(timeout=5)
+
+    def test_pressure_at_cap_never_strands_a_victim(self):
+        """A submitter over its OWN cap gains nothing from displacing a
+        cross-tenant victim, so under PRESSURE no victim may be removed
+        for it: a removed-but-never-settled victim would leave its
+        producer blocked forever in result() and wedge drain()/close().
+        Setup: bulk (high weight, cap 1) already holds its cap, gold
+        (low weight → worst pressure) holds the rest of a full queue;
+        bulk submits again with admission=drop under PRESSURE."""
+        pl, d, tids = self._mk(spec="bulk=4:cap=1,gold=0.5",
+                               admission="drop", inflight=1,
+                               queue_batches=2)
+        try:
+            d.gate.clear()
+            pl.submit(tagged_batch(4, start=0, tenant=tids["bulk"]))
+            time.sleep(0.1)          # the worker pops this one pre-gate
+            b1 = pl.submit(tagged_batch(4, start=4, tenant=tids["bulk"]))
+            g0 = pl.submit(tagged_batch(4, start=8, tenant=tids["gold"]))
+            assert not b1.dropped and not g0.dropped   # queue now full
+            pl.set_overload_state(OVERLOAD_PRESSURE)
+            t = pl.submit(tagged_batch(4, start=12, tenant=tids["bulk"]))
+            assert t.dropped         # rejected against its own budget
+            # the drop counts in BOTH admission families (aggregate and
+            # tenant-labeled) and g0 was NOT displaced for a submission
+            # that could never be admitted
+            assert pl.metrics.counters.get(
+                "pipeline_admission_drops_total") == 1
+            assert pl.metrics.counters.get(
+                'pipeline_admission_drops_total{tenant="bulk"}') == 1
+            assert not g0.dropped
+            d.gate.set()
+            # the wedge the stranded victim used to cause: drain() hung
+            # forever because _outstanding never drained
+            assert pl.drain(timeout=10)
+            b1.result(timeout=5)
+            g0.result(timeout=5)
         finally:
             pl.close(timeout=5)
 
