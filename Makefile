@@ -10,8 +10,8 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
-        ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke shim \
-        bench clean
+        ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke \
+        fqdn-smoke shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -184,7 +184,21 @@ qos-smoke:
 	$(PYTEST_ENV) python bench.py --tenants > /tmp/cilium_tpu_qos_gate.json
 	$(PYTEST_ENV) python bench.py --tenants --compare /tmp/cilium_tpu_qos_gate.json > /dev/null
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke
+# In-band DNS plane gate (fqdn/ + the delta-path identity retirement in
+# compile/incremental.py): the tier-1 FQDN subset (parser edge cases,
+# proxy fail-open, refresh coalescing, retirement/fresh-rebuild parity,
+# the wire-path feeder tap) plus the cfg9 churn workload behind its
+# exit-4 gate (zero oracle mismatches at sampling 1.0, established
+# survival >= 0.99, zero full rebuilds in steady churn, refresh p99
+# inside the delta budget) — run twice to prove --compare regression
+# detection stays wired.
+fqdn-smoke:
+	$(PYTEST_ENV) python -m pytest tests/test_fqdn.py tests/test_fqdn_plane.py -q -m "not slow"
+	$(PYTEST_ENV) python -m pytest tests/test_fqdn_plane.py -q -m slow
+	$(PYTEST_ENV) python bench.py --fqdn > /tmp/cilium_tpu_fqdn_gate.json
+	$(PYTEST_ENV) python bench.py --fqdn --compare /tmp/cilium_tpu_fqdn_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke fqdn-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

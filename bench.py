@@ -127,6 +127,10 @@ COMPARE_FIELDS = (
     ("victim_survival_min", +1),
     ("lane_e2e_p99_ms", -1),
     ("flood_admitted_share", -1),
+    # --fqdn artifacts: DNS-churn policy refresh on the delta path
+    ("refresh_p50_ms", -1),
+    ("refresh_p99_ms", -1),
+    ("established_survival", +1),
     # --update-storm artifacts: live-patch latency under pipelined traffic
     ("rule_add_ms", -1),
     ("rule_add_p99_ms", -1),
@@ -1720,6 +1724,290 @@ def tenants_bench(preset: str, verbose: bool = False, batch: int = 256):
         },
         "drained": bool(drained),
         "qos_gate": {
+            "failed": bool(gate_reasons),
+            **({"reasons": gate_reasons} if gate_reasons else {}),
+        },
+    }
+
+
+def fqdn_bench(preset: str, verbose: bool = False, batch: int = 256):
+    """cfg9: toFQDNs policy under DNS churn at storm rates (ROADMAP item
+    1b — the in-band DNS plane over the live pipelined engine).
+
+    One endpoint serves an egress ``toFQDNs`` world: a matchPattern rule
+    (``*.svc.example.com``, toPorts 443) plus the DNS L7 redirect class
+    (UDP/53 to the resolver). Learning rides the WIRE shape: every tick
+    submits a DNS batch through the pipeline, the verdict output marks
+    the redirect rows, and the proxy tap (fqdn/proxy.observe_batch —
+    the exact call the shim feeder makes at verdict-apply) decodes the
+    harvested response payloads into the FQDN cache.
+
+    Churn model, all on the cache's logical clock:
+
+    - **stable names** re-resolve every tick with a long TTL — their
+      identities must never flap; established flows to them are the
+      survival population.
+    - **churn names** arrive fresh every tick with a short TTL and die
+      two ticks later through the fqdn-gc expiry — a steady
+      grow-and-retire stream the delta path must absorb: every refresh
+      is a coalesced rule refresh + identity growth + identity
+      retirement through ``place_patch``, NEVER a full rebuild.
+
+    The parity auditor rides at sampling 1.0 (retirement tombstones must
+    be bit-identical to a fresh build under the oracle). ``fqdn_gate``
+    fails the artifact (exit 4) on: any parity mismatch (or nothing
+    checked), established survival < 99%, any full rebuild during
+    steady churn, refresh p99 past the delta-path budget
+    (max(25ms, 0.5x the measured full-build p50) — the patch path must
+    beat half a rebuild or it isn't earning its complexity), zero
+    learned/retired identities (the churn never actually exercised the
+    plane), or an unclean drain."""
+    from cilium_tpu.fqdn.dnsparse import encode_response
+    from cilium_tpu.fqdn.proxy import DNSProxy
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.engine import Engine
+
+    smoke = preset == "smoke"
+    ticks = 16 if smoke else 48
+    churn_per_tick = 3 if smoke else 8
+    n_stable = 6
+    stable_ttl, churn_ttl, tick_s = 10_000, 15, 7     # churn lives 2 ticks
+    payload_w = 512
+    cfg = DaemonConfig(
+        ct_capacity=1 << 13, auto_regen=False, batch_size=batch,
+        pipeline_flush_ms=5.0, pipeline_queue_batches=16,
+        pipeline_block_timeout_s=0.05,
+        audit_enabled=True, audit_sample_rate=1.0, audit_pool_batches=64,
+        flowlog_mode="none",
+        fqdn_proxy_enabled=True, fqdn_min_ttl=0)
+    eng = Engine(cfg, datapath=JITDatapath(cfg))
+    eng.auditor.configure(sample_rate=1.0)
+    L = [50_000]                       # logical clock (seconds)
+    eng.ctx.fqdn_cache.clock = lambda: L[0]
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.0.10",), ep_id=1)
+    eng.apply_policy([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            # the DNS L7 redirect class: queries to the resolver carry
+            # VERDICT_REDIRECT (allow-all L7 set — replies always flow)
+            {"toCIDR": ["8.8.8.8/32"],
+             "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}],
+                          "rules": {"http": [{}]}}]},
+            {"toFQDNs": [{"matchPattern": "*.svc.example.com"}],
+             "toPorts": [{"ports": [{"port": "443",
+                                     "protocol": "TCP"}]}]},
+        ]}])
+    eng.regenerate()
+    eng.start_pipeline()
+    proxy = DNSProxy(eng.ctx.fqdn_cache, metrics=eng.metrics,
+                     min_ttl=cfg.fqdn_min_ttl, port=cfg.fqdn_proxy_port,
+                     payload_width=payload_w)
+
+    stable_ip = {i: f"20.0.{i}.1" for i in range(n_stable)}
+
+    def dns_batch(answers):
+        """One DNS exchange batch: egress UDP/53 query rows to the
+        resolver, the harvested response payload riding the poll-buffer
+        columns — the wire shape the feeder tap sees."""
+        n = len(answers)
+        b = _base_batch(n, direction=0)
+        b["dst"][:, 3] = 0x08080808
+        b["sport"][:] = 30000 + np.arange(n)
+        b["dport"][:] = 53
+        b["proto"][:] = 17
+        b["tcp_flags"][:] = 0
+        b["_dns_payload"] = np.zeros((n, payload_w), np.uint8)
+        b["_dns_len"] = np.zeros((n,), np.int32)
+        for i, (name, ip, ttl) in enumerate(answers):
+            wire = encode_response(name, [ip], ttl=ttl)
+            w = min(len(wire), payload_w)
+            b["_dns_payload"][i, :w] = np.frombuffer(wire[:w], np.uint8)
+            b["_dns_len"][i] = w
+        return b
+
+    def traffic_batch(n, syn):
+        """Established-population flows to the STABLE learned IPs."""
+        b = _base_batch(n, direction=0)
+        idx = np.arange(n) % n_stable
+        b["dst"][:, 3] = (0x14000001 + (idx << 8)).astype(np.uint32)
+        b["sport"][:] = 41000 + np.arange(n) % 256
+        b["dport"][:] = 443
+        b["tcp_flags"][:] = 0x02 if syn else 0x10
+        return b
+
+    def learn(answers):
+        """DNS batch through the pipeline; tap the verdict output."""
+        b = dns_batch(answers)
+        tk = eng.submit(b, now=L[0])
+        out = tk.result(timeout=60.0)
+        n_red = int(np.asarray(out["redirect"]).sum())
+        proxy.observe_batch(b, out)
+        return n_red
+
+    # -- phase 0: seed + full-build baseline --------------------------------
+    # learn the stable names, establish the survival flows, then measure
+    # what a FULL rebuild of this world costs — the delta-path budget's
+    # denominator
+    for i in range(n_stable):
+        learn([(f"s{i}.svc.example.com", stable_ip[i], stable_ttl)])
+    eng.regenerate()
+    tb = traffic_batch(min(batch, 128), syn=True)
+    eng.submit(tb, now=L[0]).result(timeout=60.0)      # CT establishment
+    full_ms = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        eng.regenerate(force=True)
+        full_ms.append((time.monotonic() - t0) * 1e3)
+    full_p50 = float(np.percentile(full_ms, 50))
+    refresh_budget_ms = max(25.0, 0.5 * full_p50)
+    eng.regenerate()                   # settle; re-seed the delta path
+
+    # -- phase 1: steady churn ----------------------------------------------
+    fulls0 = eng.metrics.counters.get("regen_full_total", 0)
+    retired0 = eng.metrics.counters.get("fqdn_identities_retired_total", 0)
+    created0 = eng.repo.fqdn_identities_created
+    refresh_samples = []
+    surv_rows = surv_allowed = 0
+    dns_rows = redirect_rows = 0
+    pending = []
+    for tick in range(ticks):
+        L[0] += tick_s
+        # the tick's DNS storm: stable refreshes + fresh churn names
+        answers = [(f"s{i}.svc.example.com", stable_ip[i], stable_ttl)
+                   for i in range(n_stable)]
+        for j in range(churn_per_tick):
+            answers.append((f"c{tick}-{j}.svc.example.com",
+                            f"20.1.{tick % 200}.{j + 1}", churn_ttl))
+        redirect_rows += learn(answers)
+        dns_rows += len(answers)
+        # expiry: churn names from two ticks ago die here (fqdn-gc tick)
+        eng.ctx.fqdn_cache.expire(L[0])
+        # the refresh the gate times: coalesced flush + identity growth
+        # AND retirement through the delta path, in one cycle
+        t0 = time.monotonic()
+        eng.regenerate()
+        refresh_samples.append((time.monotonic() - t0) * 1e3)
+        # established flows to stable names keep serving THROUGH the churn
+        n = min(batch, 128)
+        try:
+            pending.append((eng.submit(traffic_batch(n, syn=False),
+                                       now=L[0]), n))
+        except Exception:
+            surv_rows += n             # whole batch lost
+        done = []
+        for tk, rows in pending:
+            if tk.done():
+                done.append((tk, rows))
+        for tk, rows in done:
+            pending.remove((tk, rows))
+            try:
+                out = tk.result(timeout=0)
+                surv_allowed += int(np.asarray(out["allow"]).sum())
+            except Exception:
+                pass
+            surv_rows += rows
+        eng.audit_step(budget=16)
+    for tk, rows in pending:
+        try:
+            out = tk.result(timeout=60.0)
+            surv_allowed += int(np.asarray(out["allow"]).sum())
+        except Exception:
+            pass
+        surv_rows += rows
+
+    # -- drain + audit ------------------------------------------------------
+    drained = eng.drain(timeout=120)
+    for _ in range(200):
+        step = eng.audit_step(budget=128)
+        if not step or (not step.get("replayed")
+                        and not step.get("pending")):
+            break
+    audit = eng.auditor.stats()
+    fulls_delta = eng.metrics.counters.get("regen_full_total", 0) - fulls0
+    retired = eng.metrics.counters.get(
+        "fqdn_identities_retired_total", 0) - retired0
+    created = eng.repo.fqdn_identities_created - created0
+    coalesced = eng.repo.fqdn_refresh_coalesced
+    fqdn_doc = eng.fqdn_status()
+    eng.stop()
+
+    survival = surv_allowed / max(1, surv_rows)
+    refresh_p50 = float(np.percentile(refresh_samples, 50))
+    refresh_p99 = float(np.percentile(refresh_samples, 99))
+
+    gate_reasons = []
+    if audit["mismatched_rows"]:
+        gate_reasons.append(
+            f"parity: {audit['mismatched_rows']} mismatched rows at "
+            "sampling 1.0 under FQDN churn")
+    if audit["checked_rows"] == 0:
+        gate_reasons.append("auditor checked nothing")
+    if survival < 0.99:
+        gate_reasons.append(
+            f"established survival {survival:.4f} < 0.99 — stable-name "
+            "flows lost verdicts during churn refreshes")
+    if fulls_delta:
+        gate_reasons.append(
+            f"{fulls_delta} full rebuild(s) during steady churn — the "
+            "delta path fell back")
+    if refresh_p99 > refresh_budget_ms:
+        gate_reasons.append(
+            f"refresh p99 {refresh_p99:.3f}ms > delta budget "
+            f"{refresh_budget_ms:.3f}ms (full build p50 {full_p50:.3f}ms)")
+    if created == 0 or retired == 0:
+        gate_reasons.append(
+            f"churn exercised nothing (created={created} "
+            f"retired={retired})")
+    if redirect_rows == 0:
+        gate_reasons.append("no DNS row ever carried the redirect class")
+    if not drained:
+        gate_reasons.append("pipeline did not drain clean")
+
+    if verbose:
+        print(f"# fqdn preset={preset} survival={survival:.4f} refresh "
+              f"p50/p99={refresh_p50:.3f}/{refresh_p99:.3f}ms (budget "
+              f"{refresh_budget_ms:.3f}ms, full {full_p50:.3f}ms) "
+              f"created/retired={created}/{retired} fulls={fulls_delta} "
+              f"audit={audit['checked_rows']}/{audit['mismatched_rows']}",
+              file=sys.stderr)
+
+    return {
+        "metric": "fqdn_churn_cfg9",
+        "value": round(refresh_p99, 3),
+        "unit": "refresh_p99_ms",
+        "vs_baseline": round(refresh_p99 / max(1e-9, refresh_budget_ms), 4),
+        "preset": preset,
+        "batch": batch,
+        "refresh_p50_ms": round(refresh_p50, 3),
+        "refresh_p99_ms": round(refresh_p99, 3),
+        "established_survival": round(survival, 6),
+        "refresh": {
+            "samples": len(refresh_samples),
+            "budget_ms": round(refresh_budget_ms, 3),
+            "full_build_p50_ms": round(full_p50, 3),
+            "full_rebuilds_in_churn": fulls_delta,
+        },
+        "churn": {
+            "ticks": ticks,
+            "names_per_tick": churn_per_tick,
+            "stable_names": n_stable,
+            "dns_rows": dns_rows,
+            "redirect_rows": redirect_rows,
+            "identities_created": created,
+            "identities_retired": retired,
+            "refreshes_coalesced": coalesced,
+        },
+        "survival": {"rows": surv_rows, "allowed": surv_allowed},
+        "fqdn": fqdn_doc,
+        "audit": {
+            "checked_rows": audit["checked_rows"],
+            "checked_batches": audit["checked_batches"],
+            "mismatched_rows": audit["mismatched_rows"],
+            "skipped_batches": audit["skipped_batches"],
+        },
+        "drained": bool(drained),
+        "fqdn_gate": {
             "failed": bool(gate_reasons),
             **({"reasons": gate_reasons} if gate_reasons else {}),
         },
@@ -3536,6 +3824,16 @@ def main(argv=None):
                          "lane e2e p99 vs unloaded baseline, and the DRR "
                          "admitted-row shares vs the 4:2:1 weights; "
                          "auditor at sampling 1.0; gate failures exit 4")
+    ap.add_argument("--fqdn", action="store_true",
+                    help="cfg9 FQDN churn: toFQDNs policy under a DNS "
+                         "storm on the pipelined engine — stable names "
+                         "keep their established flows serving while "
+                         "short-TTL churn names grow AND retire "
+                         "identities through the delta path every tick; "
+                         "reports refresh p50/p99 vs the delta budget, "
+                         "established survival, full-rebuild count "
+                         "(must be 0); auditor at sampling 1.0; gate "
+                         "failures exit 4")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="cfg7 multi-host serving: N engine PROCESSES over "
                          "one clustermesh store (runtime/cluster.py) — "
@@ -3716,6 +4014,22 @@ def main(argv=None):
             if result["compare"]["failed"]:
                 rc = 4
         if result.get("qos_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
+    if args.fqdn:
+        result = fqdn_bench(preset, verbose=args.verbose,
+                            batch=min(batch, 256))
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("fqdn_gate", {}).get("failed"):
             rc = 4
         _progress["headline"] = result
         print(json.dumps(result))

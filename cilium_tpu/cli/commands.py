@@ -1648,6 +1648,83 @@ def _chaos_inprocess(failures: int, seed: int, datapath_kind: str,
         f"({fell} tickets), {q_errors} errors, {q_div} verdict "
         f"divergences, state={qstats.get('state')}")
 
+    # -- phase 6: dns-poison — fqdn.parse fail-open -------------------------
+    # the in-band DNS learning tap's parser blows up mid-storm: every
+    # faulted batch loses LEARNING only (counted in parse_errors), never
+    # the reply — DNS verdicts stay bit-identical to the unfaulted
+    # baseline, the cache stays empty while the fault is armed, and
+    # learning resumes the moment the fault exhausts; a crafted
+    # garbage-body frame afterwards is counted malformed and learns
+    # nothing (the actual poisoning attempt)
+    from cilium_tpu.fqdn.dnsparse import HEADER_LEN, encode_response
+    from cilium_tpu.fqdn.proxy import DNSProxy
+
+    dns_policy = _CHAOS_POLICY + [{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [{"toCIDR": ["9.9.9.9/32"],
+                    "toPorts": [{"ports": [{"port": "53",
+                                            "protocol": "UDP"}],
+                                 "rules": {"http": [{}]}}]}],
+    }]
+    deng = mk_engine()
+    deng.add_endpoint(["k8s:app=web"], ips=("192.168.1.10",), ep_id=1)
+    deng.apply_policy(dns_policy)
+    dslot = deng.active.snapshot.ep_slot_of
+    proxy = DNSProxy(deng.ctx.fqdn_cache, metrics=deng.metrics)
+    good = encode_response("poison.example.com", ["10.7.7.7"], ttl=300)
+    bad = bytearray(encode_response("poison.example.com", ["10.7.7.8"],
+                                    ttl=300))
+    bad[HEADER_LEN:] = b"\xff" * (len(bad) - HEADER_LEN)  # valid header,
+    bad = bytes(bad)                                      # garbage body
+
+    def dns_batch(frame):
+        s16, _ = parse_addr("192.168.1.10")
+        d16, _ = parse_addr("9.9.9.9")
+        rec = PacketRecord(s16, d16, 41053, 53, C.PROTO_UDP, 0,
+                           False, 1, C.DIR_EGRESS)
+        b = batch_from_records([rec], dslot)
+        nrow = b["valid"].shape[0]
+        b["_dns_payload"] = np.zeros((nrow, 512), dtype=np.uint8)
+        b["_dns_len"] = np.zeros((nrow,), dtype=np.int32)
+        b["_dns_payload"][0, :len(frame)] = np.frombuffer(
+            frame, dtype=np.uint8)
+        b["_dns_len"][0] = len(frame)
+        return b
+
+    def tap(frame, now):
+        b = dns_batch(frame)
+        out = deng.classify(b, now=now)
+        proxy.observe_batch(b, out)
+        return out
+
+    base = deng.classify(dns_batch(good), now=900)
+    dns_baseline = [bool(a) for a in base["allow"]]
+    redirect_seen = bool(np.asarray(base["redirect"]).any()) \
+        and dns_baseline[0]
+    n_fault = 3
+    FAULTS.arm("fqdn.parse", mode="fail", times=n_fault)
+    dns_div = 0
+    for i in range(n_fault):
+        out = tap(good, now=901 + i)
+        if [bool(a) for a in out["allow"]] != dns_baseline:
+            dns_div += 1
+    FAULTS.disarm("fqdn.parse")
+    errs_fault = proxy.parse_errors_total
+    starved = len(deng.ctx.fqdn_cache) == 0       # fault cost learning
+    tap(good, now=910)                            # fault gone: learning back
+    recovered = len(deng.ctx.fqdn_cache) == 1 and proxy.observed_total == 1
+    tap(bad, now=911)                             # the poison frame itself
+    poison_rejected = len(deng.ctx.fqdn_cache) == 1 \
+        and proxy.parse_errors_total == errs_fault + 1
+    report.record(
+        "dns-poison",
+        redirect_seen and dns_div == 0 and errs_fault == n_fault
+        and starved and recovered and poison_rejected,
+        f"{n_fault} injected parse faults on the DNS tap: {errs_fault} "
+        f"counted, {dns_div} verdict divergences, cache starved during "
+        f"fault={starved}, learning resumed after={recovered}, garbage "
+        f"frame counted malformed and learned nothing={poison_rejected}")
+
 
 def _chaos_live(args, report: _ChaosReport) -> None:
     """Drive the chaos scenario against a running agent over its REST

@@ -102,6 +102,7 @@ class UpdateStats:
     port_class_splits: int = 0
     delta_rows: int = 0                # rows shipped as a sparse delta
     new_identities: int = 0            # appended identity classes (ISSUE 12)
+    retired_identities: int = 0        # tombstoned identities (ISSUE 18)
     lpm_rebuilt: bool = False          # ipcache delta → new trie tensors
     fallback: Optional[str] = None     # reason a full rebuild was required
 
@@ -188,6 +189,11 @@ class IncrementalCompiler:
     #: rules) falls back to a full rebuild — a mass remote-cluster join is
     #: cheaper as one compile than thousands of appends
     IDENT_GROWTH_MAX = 512
+    #: identity-retirement budget (ISSUE 18): a cycle tombstoning more
+    #: LOCAL identities than this (each zeroes its class row per plane)
+    #: falls back — a mass expiry (cache flush, checkpoint restore) is
+    #: cheaper as one compile than thousands of row tombstones
+    IDENT_RETIRE_MAX = 512
 
     def __init__(self, repo: Repository, ctx: PolicyContext,
                  endpoints: Sequence[Endpoint], snap: PolicySnapshot,
@@ -324,13 +330,23 @@ class IncrementalCompiler:
         shape) and ipcache changes no longer gate to a full rebuild: new
         identities append singleton classes (verdict rows recomputed,
         matching resident rules re-contribute their keys), and an ipcache
-        delta rebuilds just the LPM trie tensors into the patch."""
+        delta rebuilds just the LPM trie tensors into the patch.
+
+        ISSUE 18: LOCAL identity RETIREMENT (FQDN TTL expiry, CIDR rule
+        removal) rides the delta path too — the retired id is dropped
+        from the index, its now-empty class row tombstones to MISS
+        through the sparse delta, and the accompanying ipcache delete
+        rebuilds the LPM without the prefix. The class axis never
+        shrinks (geometry is stable), so growth + retirement in the
+        same cycle — the steady-churn FQDN shape — still ships as one
+        patch. Non-local removals and over-budget mass expiries still
+        fall back."""
         stats = UpdateStats()
         gate = self._gate(endpoints)
         if gate is not None:
             self.last_fallback = gate
             return None
-        gate, new_idents = self._identity_delta()
+        gate, new_idents, retired = self._identity_delta()
         if gate is not None:
             self.last_fallback = gate
             return None
@@ -379,6 +395,13 @@ class IncrementalCompiler:
         if new_idents:
             forced_rows = self._grow_identities(new_idents, patch, dirty,
                                                 stats)
+        # retirement SECOND, still before the changelog replay: the dirty
+        # re-merge below must find retired ids already un-indexed (their
+        # keys skip, mirroring policy_image's unknown-identity skip) — a
+        # retired id reaching _split_identity would grow geometry and
+        # force a full verdict upload for what is a row tombstone
+        if retired:
+            forced_rows |= self._retire_identities(retired, stats)
         for ch in changes:
             self._apply_change(ch, dirty)
 
@@ -490,7 +513,7 @@ class IncrementalCompiler:
                           ipcache_revision=ipcache_rev if ipcache_dirty
                           else None)
         self.base = snap
-        if new_idents:
+        if new_idents or retired:
             self.identity_sig = tuple(
                 i.id for i in self.ctx.allocator.all())
         return snap, patch, stats
@@ -512,23 +535,40 @@ class IncrementalCompiler:
             return "allow-localhost-changed"
         return None
 
-    def _identity_delta(self) -> Tuple[Optional[str], List]:
-        """→ (fallback reason, new identities). Pure growth is absorbable
-        (appended singleton classes); a removed identity would shrink the
-        class axis — a geometry rewrite the full compiler owns. Removal +
-        re-add of the same id cannot be confused with stability: allocator
-        ids are never reused (monotonic counters)."""
+    def _identity_delta(self) -> Tuple[Optional[str], List, List[int]]:
+        """→ (fallback reason, new identities, retired identity ids).
+
+        Growth appends singleton classes. Retirement (ISSUE 18) is
+        absorbable only for LOCAL-scope identities (CIDR/FQDN-learned —
+        the TTL-churn population): dropping a member never changes the
+        surviving members' shared key pattern, so no re-partition is
+        needed — a class emptied by its last member tombstones its row
+        to MISS and the class axis keeps the (dead, unreachable) slot.
+        Non-local removals stay on the full-rebuild path: reserved/
+        cluster identities are structural (world/host/endpoint rows the
+        whole image is laid out around), not churn. A
+        retired id the ipcache still references also falls back: the
+        fresh LPM build would reject it, and the inconsistency means the
+        owning rule release has not landed yet. Removal + re-add of the
+        same id cannot be confused with stability: allocator ids are
+        never reused (monotonic counters)."""
         idents = self.ctx.allocator.all()
         cur = tuple(i.id for i in idents)
         if cur == self.identity_sig:
-            return None, []
+            return None, [], []
         old = set(self.identity_sig)
-        if old - set(cur):
-            return "identity-removed", []
+        removed = old - set(cur)
+        if removed:
+            if any(not (rid & C.LOCAL_IDENTITY_SCOPE) for rid in removed):
+                return "identity-removed", [], []
+            if len(removed) > self.IDENT_RETIRE_MAX:
+                return "identity-retire-budget", [], []
+            if removed & set(self.ctx.ipcache.snapshot().values()):
+                return "identity-retired-live-ipcache", [], []
         new = [i for i in idents if i.id not in old]
         if len(new) > self.IDENT_GROWTH_MAX:
-            return "identity-growth-budget", []
-        return None, new
+            return "identity-growth-budget", [], []
+        return None, new, sorted(removed)
 
     # ------------------------------------------------------------------ #
     # change application
@@ -663,6 +703,44 @@ class IncrementalCompiler:
         stats.new_identities = k
         return forced
 
+    def _retire_identities(self, retired: Sequence[int],
+                           stats: UpdateStats
+                           ) -> Set[Tuple[int, int, int]]:
+        """Drop retired LOCAL identities from the class index (ISSUE 18:
+        the FQDN TTL-expiry path). The class AXIS is untouched — geometry
+        is stable, so the cycle still qualifies for the sparse delta —
+        but a class whose last member retired is forced for recompute on
+        every plane: with no members left, :meth:`_recompute_row`
+        tombstones the row to MISS (the "zeroed policy row"). The dead
+        row is unreachable anyway once the accompanying ipcache delete
+        rebuilds the LPM without the prefix; zeroing it keeps the device
+        image equivalent to what a fresh build would never have
+        compiled. ``identity_ids``/``class_of`` keep their dead entries
+        host- and device-side: nothing resolves through them once the
+        id is out of ``index_of`` and the LPM."""
+        # index_of is SHARED with previously-emitted snapshots: copy
+        # before the first mutation (same contract as _grow_identities;
+        # a second copy in a grow+retire cycle is one small dict)
+        self.index_of = dict(self.index_of)
+        forced: Set[Tuple[int, int, int]] = set()
+        for rid in retired:
+            idx = self.index_of.pop(int(rid), None)
+            if idx is None:
+                continue
+            cls = int(self._class_of[idx])
+            members = self._members.get(cls)
+            if members is not None:
+                members.discard(int(rid))
+            if self._representative[cls] == int(rid):
+                rest = self._members.get(cls) or ()
+                self._representative[cls] = min(rest) if rest else -1
+            if not members:
+                for slot in range(len(self.endpoints)):
+                    forced.add((slot, C.DIR_EGRESS, cls))
+                    forced.add((slot, C.DIR_INGRESS, cls))
+            stats.retired_identities += 1
+        return forced
+
     def _ensure_port_boundaries(self, key: MapStateKey,
                                 patch: SnapshotPatch) -> int:
         """Split port classes so [key.port_lo, key.port_hi] is a union of
@@ -734,6 +812,15 @@ class IncrementalCompiler:
         surface."""
         n_cols = self._base_verdict.shape[3]
         if not self._enforced_value(slot, d):
+            self._overlay[(slot, d, row)] = np.full(
+                (n_cols,), C.VERDICT_MISS, dtype=np.uint16)
+            return
+        if not self._members.get(row):
+            # retired-identity tombstone (ISSUE 18): every class starts
+            # with members and only retirement empties one — zero the
+            # row to MISS rather than letting wildcard keys repopulate a
+            # class nothing can resolve into (keeps a later whole-plane
+            # recompute idempotent over dead rows)
             self._overlay[(slot, d, row)] = np.full(
                 (n_cols,), C.VERDICT_MISS, dtype=np.uint16)
             return

@@ -1,0 +1,16 @@
+"""In-band DNS plane (pkg/fqdn dataplane analog, ISSUE 18).
+
+The serving-path half of FQDN policy: ``dnsparse`` decodes harvested DNS
+response payloads (vectorized pre-screen + compression-pointer-safe name
+walk), ``proxy`` taps the feeder's verdict-apply path for rows whose
+verdict carries the DNS L7 redirect class and feeds parsed answers to
+``model/fqdn.FQDNCache.observe`` — closing the loop ROADMAP item 1b named:
+traffic-observed names drive ``toFQDNs`` identities through the delta
+patch path.
+"""
+
+from cilium_tpu.fqdn.dnsparse import decode_batch, encode_response, \
+    parse_frame
+from cilium_tpu.fqdn.proxy import DNSProxy
+
+__all__ = ["decode_batch", "encode_response", "parse_frame", "DNSProxy"]

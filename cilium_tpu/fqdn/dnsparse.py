@@ -1,0 +1,265 @@
+"""DNS response decoding over harvested payload columns (upstream:
+pkg/fqdn/dnsproxy's miekg/dns parse, rebuilt columnar).
+
+The batch entry point is :func:`decode_batch`: a vectorized numpy header
+pre-screen over every candidate row (QR/opcode/TC/rcode/counts read as
+big-endian u16 lanes — the storm-rate common case of "not a learnable
+answer" never enters Python), then a per-row walk only for rows that
+survive. The walk is compression-pointer-safe: pointers may only jump
+BACKWARD (RFC 1035 compliant encoders always do; a forward pointer is
+how crafted frames build loops), jump count and assembled name length
+are bounded, and every length field is checked against the frame edge.
+
+Malformedness is a deliberate three-way split:
+  * not-a-learnable-response (a query, TC set, non-zero rcode, zero
+    answers) — valid DNS, silently skipped;
+  * zero-length payload — no DNS was harvested for the row, skipped;
+  * anything that violates the wire grammar (truncated header, label or
+    rdata running off the frame, pointer loops/forward pointers,
+    over-long names, non-ascii labels) — counted malformed, learned
+    nothing. The proxy folds that count into
+    ``fqdn_parse_errors_total``; the reply itself is never dropped
+    (fail-open — see fqdn/proxy.py).
+
+:func:`encode_response` is the matching wire builder (tests, the cfg9
+churn driver, and the dns-poison chaos phase synthesize answers with
+it), including the 0xC00C question-pointer compression real resolvers
+emit — so the decoder's pointer path is exercised by every synthetic
+frame, not just hand-built edge cases.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+HEADER_LEN = 12
+TYPE_A = 1
+TYPE_CNAME = 5
+TYPE_AAAA = 28
+CLASS_IN = 1
+MAX_NAME_LEN = 255          # RFC 1035 §2.3.4 total name octets
+MAX_LABEL_LEN = 63
+MAX_PTR_JUMPS = 16          # backward-only already bounds loops; belt+braces
+
+
+def _read_name(buf: bytes, off: int) -> Tuple[str, int]:
+    """Walk one (possibly compressed) name starting at ``off``.
+
+    Returns ``(name, next_off)`` where ``next_off`` is the offset just
+    past the name IN THE ORIGINAL STREAM (pointers don't advance it).
+    Raises ValueError on any grammar violation.
+    """
+    n = len(buf)
+    labels: List[str] = []
+    end: Optional[int] = None     # stream offset after the name
+    jumps = 0
+    total = 0
+    while True:
+        if off >= n:
+            raise ValueError("name runs off frame")
+        b = buf[off]
+        if b == 0:
+            if end is None:
+                end = off + 1
+            break
+        if b & 0xC0 == 0xC0:
+            if off + 1 >= n:
+                raise ValueError("truncated compression pointer")
+            ptr = ((b & 0x3F) << 8) | buf[off + 1]
+            if end is None:
+                end = off + 2
+            if ptr >= off:
+                # forward/self pointers are how crafted frames loop; a
+                # compliant encoder only ever points at earlier bytes
+                raise ValueError("non-backward compression pointer")
+            jumps += 1
+            if jumps > MAX_PTR_JUMPS:
+                raise ValueError("compression pointer chain too long")
+            off = ptr
+            continue
+        if b & 0xC0:
+            raise ValueError("reserved label type")
+        if b > MAX_LABEL_LEN:
+            raise ValueError("label too long")
+        if off + 1 + b > n:
+            raise ValueError("label runs off frame")
+        total += b + 1
+        if total > MAX_NAME_LEN:
+            raise ValueError("name too long")
+        labels.append(buf[off + 1:off + 1 + b].decode("ascii"))
+        off += 1 + b
+    return ".".join(labels), end
+
+
+def _skip_question(buf: bytes, off: int) -> int:
+    _, off = _read_name(buf, off)
+    if off + 4 > len(buf):
+        raise ValueError("question runs off frame")
+    return off + 4
+
+
+def parse_frame(buf: bytes) -> Optional[Tuple[str, List[str], int]]:
+    """Decode one DNS response frame → ``(qname, ips, min_ttl)``.
+
+    Returns None for valid-but-unlearnable frames (non-response, TC,
+    rcode != 0, no A/AAAA answers); raises ValueError on malformed
+    frames. Answers attach to the FIRST question's qname regardless of
+    CNAME indirection — upstream learns the name the workload ASKED
+    for, not the alias chain's tail (pkg/fqdn: lookups are keyed by the
+    selector-matched name).
+    """
+    if not isinstance(buf, (bytes, bytearray)):
+        buf = bytes(buf)          # accept uint8 ndarray rows directly
+    if len(buf) < HEADER_LEN:
+        raise ValueError("frame shorter than DNS header")
+    flags = int.from_bytes(buf[2:4], "big")
+    qd = int.from_bytes(buf[4:6], "big")
+    an = int.from_bytes(buf[6:8], "big")
+    qr = (flags >> 15) & 1
+    opcode = (flags >> 11) & 0xF
+    tc = (flags >> 9) & 1
+    rcode = flags & 0xF
+    if qr != 1 or opcode != 0 or tc or rcode != 0 or qd < 1 or an < 1:
+        return None
+    qname, off = _read_name(buf, HEADER_LEN)
+    if not qname:
+        raise ValueError("empty qname")
+    if off + 4 > len(buf):
+        raise ValueError("question runs off frame")
+    off += 4
+    for _ in range(qd - 1):
+        off = _skip_question(buf, off)
+    ips: List[str] = []
+    min_ttl: Optional[int] = None
+    for _ in range(an):
+        _, off = _read_name(buf, off)
+        if off + 10 > len(buf):
+            raise ValueError("answer header runs off frame")
+        rtype = int.from_bytes(buf[off:off + 2], "big")
+        rclass = int.from_bytes(buf[off + 2:off + 4], "big")
+        ttl = int.from_bytes(buf[off + 4:off + 8], "big")
+        rdlen = int.from_bytes(buf[off + 8:off + 10], "big")
+        off += 10
+        if off + rdlen > len(buf):
+            raise ValueError("rdata runs off frame")
+        if rclass == CLASS_IN and rtype == TYPE_A:
+            if rdlen != 4:
+                raise ValueError("A rdata length != 4")
+            ips.append(str(ipaddress.IPv4Address(buf[off:off + 4])))
+            min_ttl = ttl if min_ttl is None else min(min_ttl, ttl)
+        elif rclass == CLASS_IN and rtype == TYPE_AAAA:
+            if rdlen != 16:
+                raise ValueError("AAAA rdata length != 16")
+            ips.append(str(ipaddress.IPv6Address(buf[off:off + 16])))
+            min_ttl = ttl if min_ttl is None else min(min_ttl, ttl)
+        # CNAME/other rrtypes: legal, contribute no addresses
+        off += rdlen
+    if not ips:
+        return None
+    return qname, ips, int(min_ttl or 0)
+
+
+def decode_batch(payload: np.ndarray, lengths: np.ndarray,
+                 rows: Optional[Sequence[int]] = None,
+                 ) -> Tuple[List[Tuple[int, str, List[str], int]], int]:
+    """Decode DNS responses out of a ``[batch, W] uint8`` payload column.
+
+    ``lengths`` is the per-row harvested byte count (0 = no payload).
+    ``rows`` optionally restricts which rows are candidates (the proxy
+    passes its verdict/port selection). Returns ``(results, malformed)``
+    where results is a list of ``(row, qname, ips, min_ttl)`` for
+    learnable answers and ``malformed`` counts grammar-violating frames.
+    """
+    payload = np.asarray(payload)
+    lengths = np.asarray(lengths)
+    if rows is None:
+        idx = np.nonzero(lengths > 0)[0]
+    else:
+        idx = np.asarray(rows, dtype=np.int64)
+        idx = idx[lengths[idx] > 0]
+    results: List[Tuple[int, str, List[str], int]] = []
+    if idx.size == 0:
+        return results, 0
+    width = payload.shape[1]
+    clipped = np.minimum(lengths[idx], width)
+    # vectorized header screen: rows too short for a header are malformed
+    # outright; the rest are screened on QR/opcode/TC/rcode/counts so
+    # only plausibly-learnable responses pay the per-row Python walk
+    short = clipped < HEADER_LEN
+    malformed = int(short.sum())
+    cand = idx[~short]
+    if cand.size == 0:
+        return results, malformed
+    hdr = payload[cand, :HEADER_LEN].astype(np.uint32)
+    flags = (hdr[:, 2] << 8) | hdr[:, 3]
+    qd = (hdr[:, 4] << 8) | hdr[:, 5]
+    an = (hdr[:, 6] << 8) | hdr[:, 7]
+    learnable = (((flags >> 15) & 1) == 1) \
+        & (((flags >> 11) & 0xF) == 0) \
+        & (((flags >> 9) & 1) == 0) \
+        & ((flags & 0xF) == 0) \
+        & (qd >= 1) & (an >= 1)
+    for r in cand[learnable]:
+        buf = payload[r, :int(min(lengths[r], width))].tobytes()
+        try:
+            parsed = parse_frame(buf)
+        except ValueError:
+            malformed += 1
+            continue
+        if parsed is not None:
+            qname, ips, ttl = parsed
+            results.append((int(r), qname, ips, ttl))
+    return results, malformed
+
+
+def encode_name(name: str) -> bytes:
+    out = bytearray()
+    for label in name.rstrip(".").split("."):
+        raw = label.encode("ascii")
+        if not 0 < len(raw) <= MAX_LABEL_LEN:
+            raise ValueError(f"bad label in {name!r}")
+        out.append(len(raw))
+        out += raw
+    out.append(0)
+    if len(out) > MAX_NAME_LEN:
+        raise ValueError(f"name too long: {name!r}")
+    return bytes(out)
+
+
+def encode_response(qname: str, ips: Sequence[str], ttl: int = 60, *,
+                    txid: int = 0, rcode: int = 0,
+                    compress: bool = True) -> bytes:
+    """Build one DNS response frame (the churn driver / test fixture).
+
+    With ``compress`` (default) answer owner names are the 0xC00C
+    pointer at the question — the encoding real resolvers emit, so
+    round-tripping through :func:`parse_frame` exercises the pointer
+    walk. ``rcode`` lets tests build NXDOMAIN-class valid-but-
+    unlearnable frames.
+    """
+    addrs = [ipaddress.ip_address(ip) for ip in ips]
+    flags = 0x8180 | (rcode & 0xF)          # QR|RD|RA response
+    out = bytearray()
+    out += int(txid).to_bytes(2, "big")
+    out += flags.to_bytes(2, "big")
+    out += (1).to_bytes(2, "big")           # qdcount
+    out += len(addrs).to_bytes(2, "big")    # ancount
+    out += (0).to_bytes(4, "big")           # ns/ar
+    qtype = TYPE_AAAA if addrs and addrs[0].version == 6 else TYPE_A
+    wire_name = encode_name(qname)
+    out += wire_name
+    out += qtype.to_bytes(2, "big") + CLASS_IN.to_bytes(2, "big")
+    for a in addrs:
+        if compress:
+            out += b"\xc0\x0c"              # pointer to the question name
+        else:
+            out += wire_name
+        rtype = TYPE_AAAA if a.version == 6 else TYPE_A
+        out += rtype.to_bytes(2, "big") + CLASS_IN.to_bytes(2, "big")
+        out += int(ttl).to_bytes(4, "big")
+        rdata = a.packed
+        out += len(rdata).to_bytes(2, "big") + rdata
+    return bytes(out)

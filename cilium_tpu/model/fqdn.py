@@ -58,13 +58,25 @@ class FQDNCache:
     """name → {ip: expiry}. Thread-safe; observers fire on any change that
     can affect policy (new IP learned, IP expired/flushed)."""
 
-    def __init__(self, min_ttl: int = 0, clock: Callable[[], float] = None):
+    def __init__(self, min_ttl: int = 0, clock: Callable[[], float] = None,
+                 max_names: int = 0, max_ips_per_name: int = 0):
         self._lock = threading.RLock()
         self._entries: Dict[str, Dict[str, int]] = {}
         self._observers: List[Callable[[], None]] = []
         # upstream tofqdns-min-ttl: clamp tiny TTLs so churn-happy records
         # don't thrash policy recomputation
         self.min_ttl = min_ttl
+        # bounds (upstream tofqdns-endpoint-max-ip-per-hostname /
+        # max-deferred-connection-deletes class of knobs): a spoofed-
+        # response storm must not grow the dict — and through
+        # materialization, the identity space — without limit. 0 =
+        # unbounded. Eviction is oldest-expiry-first: the entry closest
+        # to dying anyway is the one a bound sacrifices.
+        self.max_names = int(max_names)
+        self.max_ips_per_name = int(max_ips_per_name)
+        self._count = 0          # total live IP entries (incremental)
+        self._high_water = 0     # peak _count (ResourceLedger row)
+        self._evictions = 0      # bound-forced removals (not TTL expiry)
         # clock used when callers (rule materialization) don't pass ``now``;
         # tests override with a synthetic clock
         import time
@@ -97,6 +109,7 @@ class FQDNCache:
         expiry = now + max(int(ttl), self.min_ttl)
         changed = False
         with self._lock:
+            is_new_name = name not in self._entries
             ent = self._entries.setdefault(name, {})
             for ip in valid_ips:
                 prev = ent.get(ip)
@@ -104,7 +117,33 @@ class FQDNCache:
                     # new OR expired-but-not-yet-GC'd: either way the
                     # materialized policy may lack this IP → recompute
                     changed = True
+                if prev is None:
+                    self._count += 1
                 ent[ip] = max(prev or 0, expiry)
+            # per-name IP cap: shed oldest-expiry IPs past the bound
+            if self.max_ips_per_name > 0:
+                while len(ent) > self.max_ips_per_name:
+                    victim = min(ent, key=ent.get)
+                    del ent[victim]
+                    self._count -= 1
+                    self._evictions += 1
+                    changed = True
+            # name cap: shed the name whose LAST IP expires soonest
+            # (never the name just observed — it carries the freshest TTL)
+            if is_new_name and self.max_names > 0:
+                while len(self._entries) > self.max_names:
+                    victim = min(
+                        (n for n in self._entries if n != name),
+                        key=lambda n: max(self._entries[n].values()),
+                        default=None)
+                    if victim is None:
+                        break
+                    dead = self._entries.pop(victim)
+                    self._count -= len(dead)
+                    self._evictions += len(dead)
+                    changed = True
+            if self._count > self._high_water:
+                self._high_water = self._count
         if changed:
             self._notify()
         return changed
@@ -122,9 +161,31 @@ class FQDNCache:
                 removed += len(dead)
                 if not ent:
                     del self._entries[name]
+            self._count -= removed
         if removed:
             self._notify()
         return removed
+
+    def stats(self, now: int = None) -> Dict:
+        """Occupancy document (the ``fqdn_cache`` ResourceLedger row +
+        ``status.fqdn``): live IP count, name count, high-water,
+        bound-eviction total, and how many entries are already past
+        expiry but not yet collected by the fqdn-gc tick."""
+        if now is None:
+            now = int(self.clock())
+        with self._lock:
+            pending = sum(
+                1 for ent in self._entries.values()
+                for exp in ent.values() if exp <= now)
+            return {
+                "ips": self._count,
+                "names": len(self._entries),
+                "high_water": self._high_water,
+                "evictions": self._evictions,
+                "pending_expiries": pending,
+                "max_names": self.max_names,
+                "max_ips_per_name": self.max_ips_per_name,
+            }
 
     def lookup_selector(self, sel: FQDNSelector,
                         now: int = None) -> List[str]:
@@ -149,11 +210,29 @@ class FQDNCache:
     # -- checkpoint (upstream persists the DNS cache for FQDN policy) -------
     def export_state(self) -> Dict:
         with self._lock:
-            return {"entries": {n: dict(e)
+            return {"now": int(self.clock()),
+                    "entries": {n: dict(e)
                                 for n, e in self._entries.items()}}
 
     def restore_state(self, state: Dict) -> None:
+        # prune on restore: entries ALREADY expired when the checkpoint
+        # was written must not resurrect — materialization filters them
+        # anyway, but restored corpses would occupy the bounds and
+        # re-expire through the next GC tick as phantom policy churn.
+        # The cutoff is the EXPORTING cache's clock (carried in the
+        # checkpoint): expiries are absolute in that clock's domain, and
+        # comparing them against the restoring engine's (possibly wall)
+        # clock would wrongly flush synthetic-clock checkpoints whole.
+        cutoff = state.get("now")
         with self._lock:
-            self._entries = {n: dict(e)
-                             for n, e in state.get("entries", {}).items()}
+            self._entries = {}
+            self._count = 0
+            for n, e in state.get("entries", {}).items():
+                live = {ip: int(exp) for ip, exp in dict(e).items()
+                        if cutoff is None or int(exp) > int(cutoff)}
+                if live:
+                    self._entries[normalize_name(n)] = live
+                    self._count += len(live)
+            if self._count > self._high_water:
+                self._high_water = self._count
         self._notify()

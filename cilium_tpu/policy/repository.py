@@ -111,6 +111,16 @@ class Repository:
         self._observers: List[Callable[[int], None]] = []
         self._changes: Deque[RuleChange] = deque()
         self._changes_dropped = False    # a record fell off the window
+        # fqdn refresh coalescing (ISSUE 18): the cache observer fires
+        # per mutation; a DNS storm's N observes must fold into ONE
+        # re-materialization per regen cycle, not N. The observer only
+        # marks pending; flush_fqdn_refresh() (regen entry points) runs
+        # the single _refresh_rules. Counters are monotone — the engine
+        # delta-folds them into fqdn_* metric families.
+        self._fqdn_refresh_pending = False
+        self.fqdn_refresh_coalesced = 0
+        self.fqdn_identities_created = 0
+        self._fqdn_ident_ids: set = set()   # ids ever created for FQDN IPs
         ctx.services.add_observer(self._on_services_changed)
         ctx.fqdn_cache.add_observer(self._on_fqdns_changed)
 
@@ -292,6 +302,11 @@ class Repository:
                 ctx.ipcache.upsert(prefix, ident.id)
                 res.allocations.append((ident, prefix))
                 selector_objs.append(cidr_selector(prefix))
+                # learning accounting: allocator ids are never reused,
+                # so first-sight of an id == one FQDN-learned identity
+                if ident.id not in self._fqdn_ident_ids:
+                    self._fqdn_ident_ids.add(ident.id)
+                    self.fqdn_identities_created += 1
         cached = [ctx.selector_cache.add_selector(s) for s in selector_objs]
         return _BlockResources(wildcard=wildcard, selectors=cached)
 
@@ -331,14 +346,43 @@ class Repository:
         self._refresh_rules(lambda res: res.has_services)
 
     def _on_fqdns_changed(self) -> None:
-        """DNS cache changed: re-materialize rules with toFQDNs (the DNS
-        proxy → NameManager → policy-recompute path in upstream pkg/fqdn)."""
+        """DNS cache changed: mark toFQDNs rules for re-materialization.
+
+        Deliberately does NOT refresh inline (the pre-ISSUE-18 behavior):
+        one storm burst of N observes would run N full re-materializations.
+        Instead the refresh is debounced to one per regen cycle — the mark
+        still wakes the engine's regen trigger (observers fire at the
+        CURRENT revision; the real bump happens in the flushed refresh),
+        and every observe after the first while a refresh is pending
+        counts as coalesced."""
+        with self._lock:
+            if self._fqdn_refresh_pending:
+                self.fqdn_refresh_coalesced += 1
+                return
+            self._fqdn_refresh_pending = True
+            rev = self._revision
+        for obs in list(self._observers):
+            obs(rev)
+
+    def flush_fqdn_refresh(self) -> bool:
+        """Run the deferred toFQDNs re-materialization, if one is pending.
+        Idempotent and cheap when clean; called at every regen entry point
+        (engine regeneration, :meth:`resolve`, :meth:`changes_since`) so
+        readers never see a stale materialization."""
+        with self._lock:
+            if not self._fqdn_refresh_pending:
+                return False
+            self._fqdn_refresh_pending = False
         self._refresh_rules(lambda res: res.has_fqdns)
+        return True
 
     # -- resolution (pure read) ---------------------------------------------
     def resolve(self, endpoint: Endpoint) -> EndpointPolicy:
         """Compute the endpoint's EndpointPolicy at the current revision.
-        Allocation-free: all resources were materialized at rule insert."""
+        Allocation-free: all resources were materialized at rule insert
+        (a pending coalesced toFQDNs refresh is flushed first, so a
+        resolve never reads a stale materialization)."""
+        self.flush_fqdn_refresh()
         with self._lock:
             rules = [r for r in self._rules if r.selects(endpoint.labels)]
             revision = self._revision

@@ -210,6 +210,10 @@ def status_doc(engine: "Engine") -> Dict:
         # None unless multi-tenant QoS is armed (qos_enabled): tenant
         # table + live per-tenant admission queue depths/admitted shares
         "qos": engine.qos_status(),
+        # in-band DNS plane (ISSUE 18): cache occupancy/bounds, proxy
+        # learning/parse-error counters, refresh coalescing, identity
+        # lifecycle — always present (the cache exists proxy or not)
+        "fqdn": engine.fqdn_status(),
         # None until the autotune controller has run against a pipeline
         "autotune": engine.autotune_status(),
         "trace": engine.tracer.stats(),

@@ -234,6 +234,23 @@ class DaemonConfig:
     # per-tenant queue occupancy cap in batches for tenants without an
     # explicit :cap= (0 = uncapped; the global queue bound still applies)
     qos_tenant_cap_batches: int = 0
+    # --- in-band DNS plane (cilium_tpu/fqdn; ISSUE 18) ---
+    # fqdn_proxy_enabled arms the feeder's verdict-apply DNS tap: rows
+    # whose verdict carries the DNS L7 redirect class get their harvested
+    # response payloads (_dns_payload/_dns_len poll-buffer columns)
+    # parsed and fed to the FQDN cache. Fail-open by construction — a
+    # parse failure (or the armed fqdn.parse fault) loses learning, never
+    # the reply. Off: the feeder allocates no payload columns and the
+    # serving path is byte-identical to pre-ISSUE-18.
+    fqdn_proxy_enabled: bool = False
+    fqdn_proxy_port: int = 53        # the redirect class's DNS port
+    # min-TTL floor (upstream tofqdns-min-ttl): short-TTL records are
+    # clamped so churn-happy names don't thrash rule re-materialization
+    fqdn_min_ttl: int = 0
+    # FQDNCache bounds (upstream tofqdns-endpoint-max-ip-per-hostname
+    # class): oldest-expiry eviction past either cap; 0 = unbounded
+    fqdn_max_names: int = 4096
+    fqdn_max_ips_per_name: int = 64
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:
@@ -375,6 +392,13 @@ class DaemonConfig:
         if self.qos_tenant_cap_batches < 0:
             raise ValueError("qos_tenant_cap_batches must be >= 0 "
                              "(0 = uncapped)")
+        if not 0 < self.fqdn_proxy_port < 65536:
+            raise ValueError("fqdn_proxy_port must be in [1, 65535]")
+        if self.fqdn_min_ttl < 0:
+            raise ValueError("fqdn_min_ttl must be >= 0")
+        if self.fqdn_max_names < 0 or self.fqdn_max_ips_per_name < 0:
+            raise ValueError("fqdn_max_names and fqdn_max_ips_per_name "
+                             "must be >= 0 (0 = unbounded)")
         if self.qos_enabled or self.qos_tenants or self.qos_assign:
             # parse eagerly so a malformed spec fails at config load, not
             # mid-flood inside the admission path

@@ -72,6 +72,7 @@ class Engine:
         self.datapath = datapath
 
         alloc = IdentityAllocator()
+        from cilium_tpu.model.fqdn import FQDNCache
         self.ctx = PolicyContext(
             allocator=alloc,
             selector_cache=SelectorCache(alloc),
@@ -79,6 +80,10 @@ class Engine:
             services=ServiceRegistry(),
             enforcement_mode=self.config.enforcement_mode,
             allow_localhost=self.config.allow_localhost,
+            fqdn_cache=FQDNCache(
+                min_ttl=self.config.fqdn_min_ttl,
+                max_names=self.config.fqdn_max_names,
+                max_ips_per_name=self.config.fqdn_max_ips_per_name),
         )
         self.repo = Repository(self.ctx)
         self.endpoints: Dict[int, Endpoint] = {}
@@ -190,6 +195,7 @@ class Engine:
         self._pipeline_stopped = False   # stop() bars lazy restart
         self._pipeline_sharded = False   # pipeline delivers steered batches
         self._feeder = None        # shim/feeder.py harvest thread
+        self._dns_proxy = None     # fqdn/proxy.py learning tap (feeder)
         self._pack_stats_seen: Dict[str, int] = {}  # scrape-delta baseline
         self._pack_fold_lock = threading.Lock()     # concurrent scrapes
         self._remap_snap = None    # dispatch-time slot-LUT cache key
@@ -350,6 +356,11 @@ class Engine:
 
     def _regenerate_locked(self, force: bool) -> CompiledSnapshot:
         """The compile+place body of :meth:`regenerate` (lock held)."""
+        # flush the coalesced FQDN refresh FIRST — before the dirty-event
+        # clear and before the incremental compiler computes its identity
+        # delta — so N debounced cache observes materialize as ONE rule
+        # refresh whose identity growth/retirement this very cycle sees
+        self.repo.flush_fqdn_refresh()
         # clear BEFORE compiling: a concurrent observer marking dirty
         # mid-compile must survive into the next regeneration (clearing
         # after the swap would lose that mark)
@@ -385,6 +396,10 @@ class Engine:
                 # the PR 9 budget headroom the resource ledger samples
                 # (patch_budget / ident_growth rows)
                 self._last_update_stats = stats
+                if stats.retired_identities:
+                    self.metrics.inc_counter(
+                        "fqdn_identities_retired_total",
+                        stats.retired_identities)
             else:
                 logging.getLogger("cilium_tpu.engine").debug(
                     "incremental fallback: %s", self._inc.last_fallback)
@@ -876,6 +891,13 @@ class Engine:
                     f"shim batch_size {shim.batch_size} exceeds the "
                     f"pipeline's max bucket (batch_size={cfg.batch_size})")
             self.start_pipeline()
+            if cfg.fqdn_proxy_enabled and self._dns_proxy is None:
+                # in-band DNS plane: the learning tap rides the feeder's
+                # verdict-apply path (fqdn/proxy.py — fail-open, counted)
+                from cilium_tpu.fqdn.proxy import DNSProxy
+                self._dns_proxy = DNSProxy(
+                    self.ctx.fqdn_cache, metrics=self.metrics,
+                    min_ttl=cfg.fqdn_min_ttl, port=cfg.fqdn_proxy_port)
             self._feeder = ShimFeeder(
                 shim, self,
                 pool_batches=cfg.ingest_pool_batches,
@@ -896,7 +918,10 @@ class Engine:
                 event_sink=self._pipeline_event,
                 # QoS armed: harvest stamps the per-row tenant id the
                 # admission queue's weighted-fair scheduling keys on
-                qos=self.qos).start()
+                qos=self.qos,
+                # DNS plane armed: poll buffers grow the payload columns
+                # and the verdict-apply path taps the learning proxy
+                fqdn=self._dns_proxy).start()
             return self._feeder
 
     def feeder_stats(self) -> Optional[Dict]:
@@ -1108,6 +1133,23 @@ class Engine:
             doc["lane_bucket_rows"] = ps.get("lane_bucket_rows", 0)
         return doc
 
+    def fqdn_status(self) -> Dict:
+        """The in-band DNS plane document (``status.fqdn``): cache
+        occupancy/bounds/high-water, proxy learning counters (frames
+        seen, answers observed, parse errors — the fail-open loss
+        signal), and the repository's refresh-coalescing / identity
+        lifecycle counters."""
+        doc: Dict = {
+            "proxy_enabled": bool(self.config.fqdn_proxy_enabled),
+            "cache": self.ctx.fqdn_cache.stats(),
+            "refresh_coalesced": self.repo.fqdn_refresh_coalesced,
+            "identities_created": self.repo.fqdn_identities_created,
+        }
+        px = self._dns_proxy
+        if px is not None:
+            doc["proxy"] = px.stats()
+        return doc
+
     # -- resource pressure ledger (observe/pressure.py; ISSUE 13) --------------
     # Provider contract: each returns {resource: (capacity, occupancy)} or
     # (capacity, occupancy, pressure) — the 3-tuple hands through a
@@ -1126,6 +1168,7 @@ class Engine:
         self.ledger.register("compile", self._res_compile)
         self.ledger.register("observe", self._res_observe)
         self.ledger.register("datapath", self._res_datapath)
+        self.ledger.register("fqdn", self._res_fqdn)
 
     def _res_ct(self) -> Dict:
         # the ct_occupancy gauge IS the canonical fraction: hand it
@@ -1210,6 +1253,14 @@ class Engine:
                              else self._inc.IDENT_GROWTH_MAX,
                              st.new_identities if st is not None else 0,
                              0.0),
+            # delta-path retirement (ISSUE 18): same last-cycle-consumption
+            # semantics as ident_growth — at budget, the cycle fell back to
+            # a full rebuild, a commanded perf cliff
+            "ident_retire": (512 if self._inc is None
+                             else self._inc.IDENT_RETIRE_MAX,
+                             getattr(st, "retired_identities", 0)
+                             if st is not None else 0,
+                             0.0),
         }
         inc = self._inc
         if inc is not None:
@@ -1224,6 +1275,17 @@ class Engine:
         out["mapstate_overlay"] = (ovs["fold_budget"], ovs["last_dirty"],
                                    0.0)
         return out
+
+    def _res_fqdn(self) -> Dict:
+        # the FQDN cache bound sheds GRACEFULLY (oldest-expiry eviction,
+        # never a crash or a dropped reply) — informational 0.0, but
+        # occupancy/high-water/ETA stay visible so a spoofed-response
+        # storm pinning the bound is attributable before identities churn
+        cache = self.ctx.fqdn_cache
+        if cache.max_names <= 0:
+            return {}  # unbounded: no capacity to report against
+        st = cache.stats()
+        return {"fqdn_cache": (cache.max_names, st["names"], 0.0)}
 
     def _res_observe(self) -> Dict:
         ts = self.tracer.stats()
@@ -1675,6 +1737,22 @@ class Engine:
                     # re-arm): re-baseline so future losses keep counting
                     # instead of waiting out the old watermark
                     self._pack_stats_seen[f"trace:{key}"] = ts[key]
+        # in-band DNS plane: the repository's process-lifetime ints
+        # (coalesced refreshes, toFQDNs identities materialized) folded as
+        # real counters — same delta-fold discipline as the pack stats.
+        # fqdn_observed_total / fqdn_parse_errors_total are incremented
+        # live by the proxy; fqdn_identities_retired_total by the regen
+        # path — only the repo's counters need folding here.
+        with self._pack_fold_lock:
+            for val, name in (
+                    (self.repo.fqdn_refresh_coalesced,
+                     "fqdn_refresh_coalesced_total"),
+                    (self.repo.fqdn_identities_created,
+                     "fqdn_identities_created_total")):
+                d = val - self._pack_stats_seen.get(f"fqdn:{name}", 0)
+                if d > 0:
+                    self.metrics.inc_counter(name, d)
+                    self._pack_stats_seen[f"fqdn:{name}"] = val
         # feeder liveness/occupancy as first-class gauge families (the
         # monotone feeder_*_total counters are already incremented live by
         # the feeder itself; these are the fields that existed only in

@@ -116,6 +116,12 @@ POINTS: Dict[str, str] = {
                               "staleness budget, degrade health with the "
                               "MESH_STALE detail — never fail closed on "
                               "established remote flows",
+    "fqdn.parse": "the DNS response decode inside the feeder's learning "
+                  "tap (fqdn/proxy.observe_batch): a trip loses LEARNING "
+                  "for that batch's DNS rows — counted in "
+                  "fqdn_parse_errors_total — while the replies keep their "
+                  "verdicts bit-identical (the fail-open contract a "
+                  "broken parser must honor; chaos phase dns-poison)",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

@@ -137,6 +137,7 @@ class ShimFeeder:
                  tracer: Optional[Tracer] = None,
                  event_sink=None,
                  qos=None,
+                 fqdn=None,
                  name: str = "feeder"):
         if not 1 <= pool_batches <= MAX_UNVERDICTED_BATCHES:
             raise ValueError(
@@ -189,6 +190,22 @@ class ShimFeeder:
             for buf in self._free:
                 buf["_tenant"] = np.zeros((shim.batch_size,),
                                           dtype=np.int32)
+        # in-band DNS plane (cilium_tpu/fqdn): with a DNSProxy armed,
+        # every poll buffer carries the harvested DNS response payload
+        # (``_dns_payload`` [batch, W] uint8, ``_dns_len`` [batch] int32)
+        # the verdict-apply tap parses into the FQDN cache. The native
+        # C++ shim has no payload channel and never writes either column
+        # (the tap sees len==0 everywhere and no-ops); DNS-capable shim
+        # stand-ins fill both during poll_batch. Proxy off: no columns,
+        # zero extra work per poll.
+        self._fqdn = fqdn
+        if fqdn is not None:
+            w = int(getattr(fqdn, "payload_width", 512))
+            for buf in self._free:
+                buf["_dns_payload"] = np.zeros((shim.batch_size, w),
+                                               dtype=np.uint8)
+                buf["_dns_len"] = np.zeros((shim.batch_size,),
+                                           dtype=np.int32)
         if n_shards > 1:
             # software RSS (SURVEY §2), HOST steering mode only: harvest
             # pre-bins each record by the direction-normalized flow hash
@@ -313,6 +330,12 @@ class ShimFeeder:
         if buf is None:
             return progressed            # pool exhausted and head not done
         now_us = int(time.monotonic() * 1e6)
+        if self._fqdn is not None:
+            # reset_batch_rows zeroes only the TAIL of optional columns and
+            # the native shim never writes them: a reused buffer would
+            # otherwise replay the PREVIOUS poll's DNS payload for head
+            # rows. len==0 makes stale payload bytes unreachable.
+            buf["_dns_len"][:] = 0
         tid = self.tracer.maybe_sample()
         try:
             with self.tracer.span(tid, "shim.harvest", force=force):
@@ -546,6 +569,15 @@ class ShimFeeder:
                 allow = out["allow"]
                 rejected = False
                 self._note_established(buf, out)
+                if self._fqdn is not None:
+                    # in-band DNS learning tap: rows whose verdict carried
+                    # the DNS L7 redirect get their response payload parsed
+                    # into the FQDN cache. Strictly after the verdict is
+                    # computed and strictly before apply_verdicts — the
+                    # proxy never raises and never touches ``allow``, so a
+                    # broken parser can only lose learning, never the reply
+                    # (the fail-open contract; fault point ``fqdn.parse``).
+                    self._fqdn.observe_batch(buf, out)
             except Exception:   # noqa: BLE001 — drop/shed/unavailable
                 pass
         try:

@@ -658,13 +658,14 @@ class TestChaosCLI:
         assert set(phases) == {"regen-storm", "regen-recovery", "peer-flap",
                                "pipeline-storm", "stall-storm", "breaker",
                                "ct-restart", "checkpoint-corruption",
-                               "qos-enqueue-failsafe"}
+                               "qos-enqueue-failsafe", "dns-poison"}
         assert all(p["ok"] for p in doc["phases"])
         assert "0 classify errors" in phases["regen-storm"]["detail"]
         assert "0 errors, 0 verdict divergences" in \
             phases["pipeline-storm"]["detail"]
         assert "0 verdict divergences" in \
             phases["qos-enqueue-failsafe"]["detail"]
+        assert "0 verdict divergences" in phases["dns-poison"]["detail"]
         # the guard phases: a watchdog restart actually happened and the
         # breaker opened within its threshold budget
         assert "watchdog restart" in phases["stall-storm"]["detail"]

@@ -252,9 +252,10 @@ class TestGeometryPaths:
     def test_identity_growth_absorbed_removal_gates(self):
         """ISSUE 12: a CIDR rule allocating NEW identities (+ ipcache
         entries) is absorbed incrementally — appended singleton classes +
-        an LPM rebuild in the patch, equivalent to a fresh build — while
-        identity REMOVAL (the rule deleted, identities released) still
-        gates to a full rebuild."""
+        an LPM rebuild in the patch, equivalent to a fresh build. Since
+        ISSUE 18, identity REMOVAL (the rule deleted, identities
+        released) is ALSO absorbed: retirement tombstones the dead
+        class's rows and excises the prefix in the same patch."""
         ctx, repo, eps = make_world()
         repo.add([l4_rule("web0", 0, 80)])
         snap = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
@@ -279,10 +280,16 @@ class TestGeometryPaths:
                             ).parse_addr("10.5.1.2")
         assert lpm_lookup_host(inc_snap.lpm, a16, False) \
             == lpm_lookup_host(fresh.lpm, a16, False)
-        # removal: the rule's release shrinks the identity set → full build
+        # removal (ISSUE 18): the rule's release retires the identity on
+        # the delta path — tombstoned verdict rows + an LPM rebuild in the
+        # patch, still equivalent to a fresh build from the shrunk world
         repo.clear()
-        assert inc.try_update(CTConfig(capacity=1024)) is None
-        assert inc.last_fallback == "identity-removed"
+        res = inc.try_update(CTConfig(capacity=1024))
+        assert res is not None, inc.last_fallback
+        inc_snap2, _patch2, stats2 = res
+        assert stats2.retired_identities == 1
+        fresh2 = build_snapshot(repo, ctx, eps, CTConfig(capacity=1024))
+        assert_equivalent(inc_snap2, fresh2, make_probes(ctx, len(eps)))
 
 
 # --------------------------------------------------------------------------- #
