@@ -11,7 +11,7 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 .PHONY: test test-fast chaos chaos-pipeline pipeline-smoke observe-smoke \
         ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke \
         ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke \
-        fqdn-smoke shim bench clean
+        fqdn-smoke chiploss-smoke lint-serving shim bench clean
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
@@ -198,7 +198,29 @@ fqdn-smoke:
 	$(PYTEST_ENV) python bench.py --fqdn > /tmp/cilium_tpu_fqdn_gate.json
 	$(PYTEST_ENV) python bench.py --fqdn --compare /tmp/cilium_tpu_fqdn_gate.json > /dev/null
 
-chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke fqdn-smoke
+# Mesh self-healing gate (ISSUE 19: runtime/datapath.remesh +
+# Pipeline.remesh + the engine's mesh-heal / ct-snapshot controllers):
+# the serving-path exception-hygiene lint (a swallowed broad catch eats
+# exactly the dispatch evidence device-loss detection runs on), the
+# tier-1 chip-loss subset — dead-device triage, fenced re-mesh geometry
+# + queued-submission survival, CT salvage/archive/grace mechanics,
+# probe-canary heal with hysteresis, degraded n-1 parity — plus the
+# cfg10 chip-loss workload behind its exit-4 gate (established survival
+# >= 0.99 through loss+heal, zero oracle mismatches at sampling 1.0,
+# degraded fps >= 0.7x the ideal (n-1)/n, exactly one re-mesh each
+# direction, the grace window actually fired, full width restored) —
+# run twice to prove --compare regression detection stays wired.
+lint-serving:
+	python tools/lint_serving.py
+
+chiploss-smoke: lint-serving
+	$(PYTEST_ENV) python -m pytest tests/test_chiploss.py \
+		"tests/test_sharded_pipeline.py::TestDegradedMeshParity" \
+		"tests/test_rss.py::TestDeviceRSSDegradedMesh" -q
+	$(PYTEST_ENV) python bench.py --chiploss > /tmp/cilium_tpu_chiploss_gate.json
+	$(PYTEST_ENV) python bench.py --chiploss --compare /tmp/cilium_tpu_chiploss_gate.json > /dev/null
+
+chaos: chaos-pipeline ingest-smoke multichip-smoke audit-smoke kernel-smoke update-smoke ddos-smoke cluster-smoke pressure-smoke rss-smoke qos-smoke fqdn-smoke chiploss-smoke
 	$(PYTEST_ENV) python -m cilium_tpu.cli.main faults chaos --failures 10
 	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q -m slow
 	$(PYTEST_ENV) python -m pytest tests/test_pipeline_guard.py -q -m slow

@@ -2014,6 +2014,308 @@ def fqdn_bench(preset: str, verbose: bool = False, batch: int = 256):
     }
 
 
+def chiploss_bench(preset: str, verbose: bool = False, batch: int = 256,
+                   shards: int = 4):
+    """cfg10: chip-loss self-healing over the live pipelined engine
+    (ISSUE 19 — the robustness counterpart to cfg9's control-plane
+    churn).
+
+    A ``shards``-device mesh serves a CT-gated reply world: the
+    endpoint's egress policy allows the forward direction, ingress is
+    enforced with nothing matching the servers — so a REPLY row passes
+    ONLY on a conntrack hit. The established population is the survival
+    metric: every reply verdict is a direct probe of CT continuity
+    through the loss.
+
+    Phases: establish + warm (the warm replies also stamp the
+    established-fingerprint filter the grace window consults) → CT
+    archive snapshot (the salvage floor) → baseline reply storm (fps
+    denominator) → arm ``device.fail`` on one ordinal mid-storm → the
+    dispatch error latches DEVICE_LOST and parks the pipeline → one
+    ``remesh_step`` fences the wedged generation and re-meshes onto the
+    survivors with CT salvage (surviving shards' entries re-steered into
+    the n-1 geometry; the lost shard's flows ride the bounded grace
+    window until forward traffic cold-learns them back) → degraded
+    reply storm (fps numerator + survival) → disarm + heal re-mesh back
+    to full width → healed storm.
+
+    The parity auditor rides at sampling 1.0 the whole way — the grace
+    flip is applied AFTER capture, so raw verdicts replay exactly and
+    the oracle takes the captured CT status as table truth.
+    ``chiploss_gate`` fails the artifact (exit 4) on: established
+    survival < 99% over resolved post-loss replies (pipeline rejects in
+    the loss window are sheds, not denials), any parity mismatch (or
+    nothing checked), degraded throughput under 0.7x the ideal (n-1)/n
+    scaling, anything but exactly one re-mesh in each direction, a
+    grace window that never fired (the loss exercised nothing), a final
+    mesh narrower than configured, or an unclean drain."""
+    import shutil
+    import tempfile
+
+    from cilium_tpu.runtime.config import DaemonConfig
+    from cilium_tpu.runtime.datapath import JITDatapath
+    from cilium_tpu.runtime.engine import Engine
+    from cilium_tpu.runtime.faults import FAULTS
+    from cilium_tpu.utils import constants as C
+
+    smoke = preset == "smoke"
+    n = max(2, shards)
+    victim = 1 % n
+    n_flows = 384 if smoke else 1536
+    ticks = 4 if smoke else 12          # storm ticks per measured phase
+    snap_dir = tempfile.mkdtemp(prefix="cilium-tpu-ct-archive-")
+    cfg = DaemonConfig(
+        n_shards=n, ct_capacity=1 << 13, auto_regen=False,
+        batch_size=batch, pipeline_flush_ms=5.0,
+        pipeline_queue_batches=16, pipeline_block_timeout_s=0.05,
+        audit_enabled=True, audit_sample_rate=1.0, audit_pool_batches=64,
+        flowlog_mode="none",
+        remesh_heal_hysteresis_s=0.0,   # the bench drives the heal tick
+        remesh_grace_s=120.0,           # survives a slow smoke rig
+        ct_snapshot_dir=snap_dir, checkpoint_max_age_s=300.0)
+    eng = Engine(cfg, datapath=JITDatapath(cfg))
+    eng.auditor.configure(sample_rate=1.0)
+    eng.add_endpoint(["k8s:app=web"], ips=("192.168.0.10",), ep_id=1)
+    eng.apply_policy([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        # forward direction: allowed by policy — the cold-learn path
+        # that re-creates CT on the survivor mesh after the loss
+        "egress": [{"toCIDR": ["10.0.0.0/8"],
+                    "toPorts": [{"ports": [{"port": "443",
+                                            "protocol": "TCP"}]}]}],
+        # ingress ENFORCED with nothing matching the servers: replies
+        # pass only on a CT hit — each one probes CT continuity
+        "ingress": [{"fromEndpoints": [
+            {"matchLabels": {"role": "backoffice"}}]}],
+    }])
+    eng.regenerate()
+    eng.start_pipeline()
+
+    flow_ids = np.arange(n_flows)
+    chunks = [flow_ids[i:i + batch] for i in range(0, n_flows, batch)]
+    shed_rows = 0
+
+    def fwd_batch(idx, flags):
+        b = _base_batch(len(idx), direction=C.DIR_EGRESS)
+        b["dst"][:, 3] = (0x0A000100 + idx).astype(np.uint32)
+        b["sport"][:] = 20000 + idx
+        b["tcp_flags"][:] = flags
+        return b
+
+    def rep_batch(idx):
+        b = _base_batch(len(idx), direction=C.DIR_INGRESS)
+        b["src"][:, 3] = (0x0A000100 + idx).astype(np.uint32)
+        b["dst"][:, 3] = 0xC0A8000A
+        b["sport"][:] = 443
+        b["dport"][:] = 20000 + idx
+        b["tcp_flags"][:] = C.TCP_ACK
+        return b
+
+    def pump(mk, count=None):
+        """Submit every chunk, resolve every ticket. Submission or
+        resolution failures (queue overflow while parked, the fenced
+        wedged window) are capacity sheds, never denials — they leave
+        the survival denominator."""
+        nonlocal shed_rows
+        tickets = []
+        for idx in chunks:
+            try:
+                tickets.append((eng.submit(mk(idx)), len(idx)))
+            except Exception:
+                shed_rows += len(idx)
+        for tk, rows in tickets:
+            try:
+                out = tk.result(timeout=60.0)
+            except Exception:
+                shed_rows += rows
+                continue
+            if count is not None:
+                count["rows"] += rows
+                count["allowed"] += int(np.asarray(out["allow"]).sum())
+
+    def storm(n_ticks, count):
+        """Forward-ACK + reply sweeps over the whole population; only
+        the reply verdicts feed survival, both directions feed fps."""
+        t0 = time.monotonic()
+        rows = 0
+        for _ in range(n_ticks):
+            pump(lambda idx: fwd_batch(idx, C.TCP_ACK))
+            pump(rep_batch, count=count)
+            rows += 2 * n_flows
+            eng.audit_step(budget=32)
+        eng.drain(timeout=120)
+        return rows / max(1e-9, time.monotonic() - t0)
+
+    # -- phase 0: establish + warm ------------------------------------------
+    pump(lambda idx: fwd_batch(idx, C.TCP_SYN))
+    assert eng.drain(timeout=120)
+    warm = {"rows": 0, "allowed": 0}
+    pump(rep_batch, count=warm)        # stamps the fingerprint filter
+    eng.drain(timeout=120)
+    eng.ct_snapshot_step()             # the archive salvage floor
+    warm_surv = warm["allowed"] / max(1, warm["rows"])
+
+    # -- phase 1: baseline storm --------------------------------------------
+    base = {"rows": 0, "allowed": 0}
+    baseline_fps = storm(ticks, base)
+
+    # -- phase 2: loss, detection, fenced re-mesh ---------------------------
+    FAULTS.arm("device.fail", mode="fail", message=f"dev={victim}")
+    t_loss0 = time.monotonic()
+    deg = {"rows": 0, "allowed": 0}
+    trip = []
+    try:
+        trip.append((eng.submit(rep_batch(chunks[0])), len(chunks[0])))
+    except Exception:
+        shed_rows += len(chunks[0])
+    deadline = time.monotonic() + 60
+    while (eng.pipeline_stats() or {}).get("state") != "device-lost" \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    detect_ms = (time.monotonic() - t_loss0) * 1e3
+    down = eng.remesh_step() or {}
+    down_ms = (time.monotonic() - t_loss0) * 1e3
+    for tk, rows in trip:
+        try:
+            out = tk.result(timeout=30.0)
+            deg["rows"] += rows        # raced the fence and resolved
+            deg["allowed"] += int(np.asarray(out["allow"]).sum())
+        except Exception:
+            shed_rows += rows          # the fenced wedged window
+
+    # -- phase 3: degraded storm --------------------------------------------
+    grace0 = eng.metrics.counters.get("ct_salvage_grace_hits_total", 0)
+    # first reply sweep BEFORE any forward traffic: the lost shard's
+    # flows must ride the grace window (fingerprint hits) — the
+    # forward ACKs of the storm then cold-learn their CT entries back
+    pump(rep_batch, count=deg)
+    degraded_fps = storm(ticks, deg)
+    grace_hits = eng.metrics.counters.get(
+        "ct_salvage_grace_hits_total", 0) - grace0
+
+    # -- phase 4: heal ------------------------------------------------------
+    FAULTS.disarm("device.fail")
+    t_up0 = time.monotonic()
+    up = eng.remesh_step() or {}
+    up_ms = (time.monotonic() - t_up0) * 1e3
+    healed = {"rows": 0, "allowed": 0}
+    healed_fps = storm(max(1, ticks // 2), healed)
+
+    # -- drain + audit ------------------------------------------------------
+    drained = eng.drain(timeout=120)
+    for _ in range(200):
+        step = eng.audit_step(budget=128)
+        if not step or (not step.get("replayed")
+                        and not step.get("pending")):
+            break
+    audit = eng.auditor.stats()
+    status = eng.remesh_status()
+    ctr = eng.metrics.counters
+    downs = ctr.get(f'datapath_remesh_total{{from="{n}",to="{n - 1}"}}', 0)
+    ups = ctr.get(f'datapath_remesh_total{{from="{n - 1}",to="{n}"}}', 0)
+    eng.stop()
+    shutil.rmtree(snap_dir, ignore_errors=True)
+
+    survival = (deg["allowed"] + healed["allowed"]) \
+        / max(1, deg["rows"] + healed["rows"])
+    ratio = degraded_fps / max(1e-9, baseline_fps)
+    ideal = (n - 1) / n
+    floor = 0.7 * ideal
+    mesh = status.get("mesh") or {}
+
+    gate_reasons = []
+    if warm_surv < 0.999 or base["allowed"] < base["rows"]:
+        gate_reasons.append(
+            f"baseline replies leaked before any loss (warm "
+            f"{warm_surv:.4f}, storm {base['allowed']}/{base['rows']}) — "
+            "the CT-gated world is broken, survival would be vacuous")
+    if survival < 0.99:
+        gate_reasons.append(
+            f"established survival {survival:.4f} < 0.99 — flows lost "
+            "verdicts through the loss/heal cycle")
+    if audit["mismatched_rows"]:
+        gate_reasons.append(
+            f"parity: {audit['mismatched_rows']} mismatched rows at "
+            "sampling 1.0 across the re-mesh")
+    if audit["checked_rows"] == 0:
+        gate_reasons.append("auditor checked nothing")
+    if ratio < floor:
+        gate_reasons.append(
+            f"degraded throughput {ratio:.3f}x baseline < "
+            f"{floor:.3f}x (0.7 * ideal {ideal:.3f} for {n}->{n - 1})")
+    if downs != 1:
+        gate_reasons.append(
+            f"{downs} loss re-mesh(es) {n}->{n - 1} — expected exactly 1")
+    if ups != 1:
+        gate_reasons.append(
+            f"{ups} heal re-mesh(es) {n - 1}->{n} — expected exactly 1")
+    if grace_hits == 0:
+        gate_reasons.append(
+            "the salvage grace window never fired — the loss exercised "
+            "nothing (no lost-shard flow ever needed it)")
+    if mesh.get("live") != mesh.get("configured"):
+        gate_reasons.append(
+            f"final mesh {mesh.get('live')}/{mesh.get('configured')} — "
+            "the healed device never re-admitted")
+    if not drained:
+        gate_reasons.append("pipeline did not drain clean")
+
+    if verbose:
+        print(f"# chiploss preset={preset} shards={n} victim={victim} "
+              f"survival={survival:.4f} fps base/deg/heal="
+              f"{baseline_fps:.0f}/{degraded_fps:.0f}/{healed_fps:.0f} "
+              f"detect={detect_ms:.1f}ms down={down_ms:.1f}ms "
+              f"up={up_ms:.1f}ms grace={grace_hits} shed={shed_rows} "
+              f"audit={audit['checked_rows']}/{audit['mismatched_rows']}",
+              file=sys.stderr)
+
+    return {
+        "metric": "chiploss_recovery_cfg10",
+        "value": round(ratio, 4),
+        "unit": "degraded_fps_ratio",
+        "vs_baseline": round(ratio / max(1e-9, ideal), 4),
+        "preset": preset,
+        "batch": batch,
+        "shards": n,
+        "victim": victim,
+        "established_survival": round(survival, 6),
+        "throughput": {
+            "baseline_fps": round(baseline_fps, 1),
+            "degraded_fps": round(degraded_fps, 1),
+            "healed_fps": round(healed_fps, 1),
+            "ideal_ratio": round(ideal, 4),
+            "floor_ratio": round(floor, 4),
+        },
+        "loss": {
+            "detect_ms": round(detect_ms, 3),
+            "down_ms": round(down_ms, 3),
+            "remesh": down.get("remesh"),
+        },
+        "heal": {
+            "up_ms": round(up_ms, 3),
+            "remesh": up.get("remesh"),
+        },
+        "salvage": {
+            "grace_hits": grace_hits,
+            "shed_rows": shed_rows,
+        },
+        "survival": {"warm": warm, "baseline": base, "degraded": deg,
+                     "healed": healed},
+        "mesh": status,
+        "audit": {
+            "checked_rows": audit["checked_rows"],
+            "checked_batches": audit["checked_batches"],
+            "mismatched_rows": audit["mismatched_rows"],
+            "skipped_batches": audit["skipped_batches"],
+        },
+        "drained": bool(drained),
+        "chiploss_gate": {
+            "failed": bool(gate_reasons),
+            **({"reasons": gate_reasons} if gate_reasons else {}),
+        },
+    }
+
+
 def cluster_bench(n_nodes: int, preset: str, verbose: bool = False):
     """cfg7: multi-host serving over the clustermesh store (ISSUE 12 /
     ROADMAP item 3 — the horizontal-scale counterpart to cfg6's
@@ -3834,6 +4136,12 @@ def main(argv=None):
                          "established survival, full-rebuild count "
                          "(must be 0); auditor at sampling 1.0; gate "
                          "failures exit 4")
+    ap.add_argument("--chiploss", action="store_true",
+                    help="cfg10 chip-loss: kill one mesh device mid-"
+                         "storm, fenced re-mesh onto survivors with CT "
+                         "salvage + grace window, then heal back to "
+                         "full width (gated by chiploss_gate, exit 4; "
+                         "--shards picks the mesh width, default 4)")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="cfg7 multi-host serving: N engine PROCESSES over "
                          "one clustermesh store (runtime/cluster.py) — "
@@ -3894,6 +4202,8 @@ def main(argv=None):
 
     import os
 
+    if args.chiploss and args.shards <= 1:
+        args.shards = 4                # the cfg10 default mesh width
     need = args.shards * args.rule_shards
     if need > 1 and not os.environ.get("CILIUM_TPU_BENCH_REAL_MESH"):
         # a virtual CPU mesh on a 1-chip rig. The env vars must land
@@ -4030,6 +4340,22 @@ def main(argv=None):
             if result["compare"]["failed"]:
                 rc = 4
         if result.get("fqdn_gate", {}).get("failed"):
+            rc = 4
+        _progress["headline"] = result
+        print(json.dumps(result))
+        if rc:
+            sys.exit(rc)
+        return
+    if args.chiploss:
+        result = chiploss_bench(preset, verbose=args.verbose,
+                                batch=min(batch, 256), shards=args.shards)
+        result["provenance"] = _provenance(argv)
+        rc = 0
+        if args.compare:
+            result["compare"] = _compare_artifacts(result, args.compare)
+            if result["compare"]["failed"]:
+                rc = 4
+        if result.get("chiploss_gate", {}).get("failed"):
             rc = 4
         _progress["headline"] = result
         print(json.dumps(result))

@@ -58,8 +58,11 @@ log = logging.getLogger("cilium_tpu.blackbox")
 #: narrate — but a forecast-then-exhaustion ("resource-exhaustion": the
 #: ledger predicted the structure would fill and then it did) is the
 #: capacity anomaly this recorder exists for, and freezes strictly.
+#: device loss and the fenced re-mesh that answers it (ISSUE 19) are
+#: exactly the anomalies a flight recorder exists for: the freeze keeps
+#: the dispatch-failure lead-up and the geometry transition in one bundle
 FREEZE_KINDS = frozenset(("watchdog", "parity-mismatch",
-                          "resource-exhaustion"))
+                          "resource-exhaustion", "device-loss", "remesh"))
 
 #: shed reasons judged against the RELAXED spike threshold: deliberate
 #: overload shedding (admission priority eviction, harvest-time SHED-NEW,

@@ -205,6 +205,39 @@ def shard_ct_arrays(ct: Dict[str, np.ndarray],
     return ct
 
 
+def degraded_ct_capacity(capacity: int, n_flow_shards: int) -> int:
+    """The largest CT capacity <= ``capacity`` that still splits into
+    ``n_flow_shards`` power-of-two local tables — the table geometry a
+    remesh onto a NON-power-of-two survivor count rehashes into (e.g.
+    4096 slots at 3 shards → 1024·3 = 3072). Healing back to a
+    power-of-two width recovers the full configured capacity."""
+    local = capacity // n_flow_shards
+    if local < 1:
+        raise ValueError(
+            f"CT capacity {capacity} cannot split across "
+            f"{n_flow_shards} shards")
+    local = 1 << (local.bit_length() - 1)
+    return local * n_flow_shards
+
+
+def drop_ct_shard(arrays: Dict[str, np.ndarray], shard: int,
+                  n_shards: int) -> int:
+    """Zero one flow shard's slot range ``[shard*local, (shard+1)*local)``
+    of a host-gathered CT table, in place. The honest-loss step of remesh
+    salvage: on the CPU smoke rig a "killed" virtual device's shard is
+    still physically gatherable, so salvage deliberately drops it — the
+    lost shard's flows must cold-learn under the established-fingerprint
+    grace window exactly as they would on real hardware. Returns the
+    number of live entries dropped."""
+    cap = arrays["expiry"].shape[0]
+    local = cap // n_shards
+    lo, hi = shard * local, (shard + 1) * local
+    n_live = int((arrays["expiry"][lo:hi] > 0).sum())
+    for k, v in arrays.items():
+        v[lo:hi] = 0
+    return n_live
+
+
 def _reverse_key_words(keys: np.ndarray) -> np.ndarray:
     """[M,10] forward CT key words → reverse orientation (addr/port swap,
     direction flip) — the host inverse of records.ct_key_words(reverse)."""

@@ -51,6 +51,7 @@ log = logging.getLogger("cilium_tpu.pipeline.guard")
 #: (gauge ``pipeline_state`` carries the numeric code)
 PIPELINE_STATES: Dict[str, int] = {
     "ok": 0, "breaker-open": 1, "restarting": 2, "failed": 3, "closed": 4,
+    "device-lost": 5,
 }
 
 #: breaker states → ``pipeline_breaker_state`` gauge codes
@@ -102,6 +103,25 @@ class PipelineUnavailable(PipelineError):
     """Fail-fast rejection: the circuit breaker is open, or the pipeline
     hard-failed after exhausting its watchdog restart budget. 503 at the
     API — the backend is sick, not merely busy."""
+
+
+class DeviceLost(RuntimeError):
+    """A dispatch failed with a dead-accelerator signature — not the
+    transient breaker/backoff territory every other dispatch error lands
+    in, but a chip that left the mesh (runtime/datapath.dead_device_of is
+    the classifier that tells the two apart). ``device`` is the ordinal
+    into the datapath's CONFIGURED device list (-1 = a device died but
+    the error named no ordinal; the engine probes to attribute it).
+
+    Deliberately NOT a :class:`PipelineError`: the scheduler treats it as
+    a mesh-health signal (park the worker, notify the engine's re-mesh
+    path) rather than a per-submission failure, and only the wedged
+    in-flight window is rejected — queued submissions survive the fenced
+    re-mesh, exactly like a watchdog restart."""
+
+    def __init__(self, message: str, device: int = -1):
+        super().__init__(message)
+        self.device = device
 
 
 class PipelineTenantCap(PipelineDrop):

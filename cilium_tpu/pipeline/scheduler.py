@@ -94,7 +94,8 @@ from cilium_tpu.observe.trace import TRACER, Tracer
 from cilium_tpu.parallel.mesh import steer_rows
 from cilium_tpu.pipeline.guard import (OVERLOAD_OVERLOAD, OVERLOAD_PRESSURE,
                                        PIPELINE_STATES, PRIO_NEW,
-                                       CircuitBreaker, PipelineClosed,
+                                       CircuitBreaker, DeviceLost,
+                                       PipelineClosed,
                                        PipelineDeadlineExceeded,
                                        PipelineDrop, PipelineError,
                                        PipelineTenantCap,
@@ -366,7 +367,8 @@ class Pipeline:
                  rss_mode: str = "host",
                  event_sink: Optional[Callable] = None,
                  qos=None,
-                 lane_bucket: int = 0):
+                 lane_bucket: int = 0,
+                 on_device_loss: Optional[Callable] = None):
         if max_bucket & (max_bucket - 1) or max_bucket <= 0:
             raise ValueError("max_bucket must be a power of two")
         if min_bucket & (min_bucket - 1) or not 0 < min_bucket <= max_bucket:
@@ -385,8 +387,13 @@ class Pipeline:
         if max_restarts < 0 or restart_backoff_s <= 0:
             raise ValueError("max_restarts must be >= 0 and "
                              "restart_backoff_s > 0")
-        if n_shards < 1 or n_shards & (n_shards - 1):
-            raise ValueError("n_shards must be a power of two >= 1")
+        if n_shards < 1:
+            # any positive count is a valid geometry: flow steering is
+            # modulo (parallel/mesh.flow_shard_of), and a fenced re-mesh
+            # leaves the serving set at n-1 survivors — a pipeline built
+            # lazily (or restarted) against a degraded datapath must come
+            # up at that same non-pow2 width remesh() would have adopted
+            raise ValueError("n_shards must be >= 1")
         if shard_headroom < 1 or shard_headroom & (shard_headroom - 1):
             raise ValueError("shard_headroom must be a power of two >= 1")
         if n_shards > 1 and shard_fn is None:
@@ -421,6 +428,19 @@ class Pipeline:
         self._n_shards = n_shards
         self._shard_fn = shard_fn
         self._shard_rev_fn = shard_rev_fn
+        # kept as an attr (unlike the other ctor-only sizing inputs):
+        # remesh() recomputes seg_cap/stage_rows for the survivor count
+        self._shard_headroom = shard_headroom
+        # mesh self-healing (ISSUE 19): a DeviceLost dispatch parks this
+        # worker (queue survives) and notifies the engine via the callback;
+        # Pipeline.remesh() is the fenced geometry swap that un-parks. With
+        # no handler wired (bare pipelines, tests) DeviceLost degrades to
+        # the generic dispatch-error path — behavior identical to pre-19.
+        self._on_device_loss = on_device_loss
+        self._device_lost: Optional[int] = None
+        # a freshly restarted/re-meshed generation proves the device path
+        # with a 1-row synthetic dispatch before serving real traffic
+        self._canary_pending = False
         if n_shards > 1:
             self._seg_cap = min(max_bucket, _next_pow2(
                 max(1, max_bucket // n_shards) * shard_headroom))
@@ -930,6 +950,8 @@ class Pipeline:
             return "closed"
         if self._restarting:
             return "restarting"
+        if self._device_lost is not None:
+            return "device-lost"
         if self.breaker.state != "closed":
             return "breaker-open"
         return "ok"
@@ -1271,6 +1293,10 @@ class Pipeline:
                 name=f"{self._name}-worker-g{new_gen}")
             self._worker_gen = new_gen
             self._cold_dispatch = True   # fresh gen: next dispatch is cold
+            # satellite (b): recovery is DECLARED only after the new
+            # worker's synthetic canary dispatch survives the real device
+            # path — not merely after a thread started
+            self._canary_pending = True
             self._worker.start()
             self._restarting = False
             self._cond.notify_all()
@@ -1296,6 +1322,208 @@ class Pipeline:
                 for t in stranded])
             return
         self._restart_worker(gen, "worker crashed")
+
+    # -- mesh self-healing (ISSUE 19) -----------------------------------------
+    def _handle_device_lost(self, exc: DeviceLost,
+                            slices: Sequence[_Slice],
+                            buf_idx: Optional[int]) -> None:
+        """A dispatch/finalize failed with a dead-accelerator signature.
+        This is NOT breaker territory (retrying cannot resurrect a chip)
+        and NOT watchdog territory (a restart would re-dispatch onto the
+        same dead mesh): reject only the failing window's slices, PARK the
+        worker — the queue and future submissions survive — and notify the
+        engine, whose fenced :meth:`remesh` swaps the geometry under a
+        fresh generation. Without a handler wired (bare pipelines) degrade
+        to the generic dispatch-error path: breaker math still bounds the
+        damage, and nothing ever parks waiting for a re-mesh that will
+        never come."""
+        self.dispatch_errors += 1
+        self.metrics.inc_counter("pipeline_dispatch_errors_total")
+        self.metrics.inc_counter(
+            f'pipeline_device_lost_total{{device="{exc.device}"}}')
+        cb = self._on_device_loss
+        if cb is None:
+            self.breaker.record_failure()
+            log.warning("pipeline dispatch lost device %d with no re-mesh "
+                        "handler wired; rejecting %d submission(s): %s",
+                        exc.device, len(slices), exc)
+            self._reject_slices(slices, exc, buf_idx)
+            return
+        with self._lock:
+            self._device_lost = exc.device
+        self._set_state_gauge()
+        self.tracer.event("pipeline.device-loss", device=exc.device)
+        self._emit("device-loss", device=exc.device, reason=str(exc))
+        log.error("pipeline: device %d LOST (%s); worker parked pending "
+                  "re-mesh, %d in-flight submission(s) rejected",
+                  exc.device, exc, len(slices))
+        self._reject_slices(slices, exc, buf_idx)
+        try:
+            cb(exc.device, str(exc))
+        except Exception:   # noqa: BLE001 — a broken handler must not
+            log.exception("on_device_loss handler failed")   # kill the worker
+
+    def remesh(self, rebuild: Callable[[], Dict],
+               reason: str = "device-loss") -> Dict:
+        """The fenced re-mesh protocol. Fences the current generation and
+        rejects ONLY the wedged in-flight window — queued submissions
+        survive — then runs ``rebuild()`` (the engine's closure: re-mesh
+        the datapath onto the survivor device set and re-place the active
+        snapshot) and adopts the geometry it returns (``n_shards``,
+        ``mesh_shards``, ``min_bucket``): seg_cap/stage_rows recomputed, a
+        fresh staging ring allocated at the new shape, per-shard gauges
+        swapped, and a new worker generation started with the canary
+        dispatch pending.
+
+        Unlike the watchdog protocol this NEVER spends restart budget — a
+        commanded geometry change is not a crash. If ``rebuild()`` raises,
+        the old geometry stands and a fresh worker restarts on it (the
+        engine owns retrying); the exception propagates to the caller.
+        Returns the adopted geometry dict."""
+        with self._lock:
+            if self._closed or self._closing:
+                raise PipelineClosed("pipeline is closing; remesh refused")
+            if self._failed:
+                raise PipelineUnavailable(
+                    "pipeline hard-failed; remesh refused")
+            self._gen += 1
+            new_gen = self._gen
+            self._restarting = True
+            self._device_lost = None
+            wedged = self._collect_wedged_locked(include_queue=False)
+        self.metrics.inc_counter("pipeline_remesh_total")
+        self._set_state_gauge()
+        self.tracer.event("pipeline.remesh", reason=reason,
+                          rejected=len(wedged))
+        self._settle([(t, None, PipelineError(
+            f"mesh re-meshed ({reason}); in-flight window rejected"))
+            for t in wedged])
+        try:
+            geom = rebuild() or {}
+        except BaseException:
+            # geometry unchanged: restart a worker on the OLD shape so
+            # queued submissions are served (or fail back into the park
+            # path if the mesh really is dead — the engine retries)
+            self._start_generation(new_gen)
+            self._emit("remesh", reason=reason, ok=False,
+                       rejected=len(wedged))
+            raise
+        with self._lock:
+            n_shards = int(geom.get("n_shards", self._n_shards))
+            mesh_shards = int(geom.get("mesh_shards", n_shards))
+            min_bucket = _next_pow2(
+                int(geom.get("min_bucket", self._min_bucket)))
+            self._n_shards = n_shards
+            self._mesh_shards = mesh_shards if mesh_shards > 0 else n_shards
+            self._min_bucket = min(min_bucket, self._max_bucket)
+            if n_shards > 1:
+                self._seg_cap = min(self._max_bucket, _next_pow2(
+                    max(1, self._max_bucket // n_shards)
+                    * self._shard_headroom))
+                self._stage_rows = n_shards * self._seg_cap
+            else:
+                self._seg_cap = 0
+                self._stage_rows = self._max_bucket
+            old_gauges = self._shard_gauge_names
+            self._shard_gauge_names = [
+                f'pipeline_staged_rows{{shard="{s}"}}'
+                for s in range(n_shards)] if n_shards > 1 else []
+            self._shard_fill = [0] * n_shards
+            self._shard_rows_total = [0] * n_shards
+            self._stage_steer_rev = None
+            # fresh ring at the NEW geometry (the wedged-collect above
+            # already re-allocated one, but at the old shape)
+            self._buffers = [_StageBuf(self._stage_rows, n_shards)
+                             for _ in range(self._inflight_max + 1)]
+            self._free_bufs = list(range(len(self._buffers)))
+            self.metrics.set_gauge("pipeline_staging_free",
+                                   len(self._free_bufs))
+            if self._mesh_shards > 1:
+                self.metrics.set_gauge("pipeline_mesh_shards",
+                                       self._mesh_shards)
+        # departed-shard gauge sweep: a 4→3 remesh must not leave
+        # shard="3" pinned at its last fill forever
+        for name in old_gauges:
+            if name not in self._shard_gauge_names:
+                self.metrics.drop_gauge(name)
+        self._start_generation(new_gen)
+        self._emit("remesh", reason=reason, ok=True, n_shards=n_shards,
+                   mesh_shards=self._mesh_shards, rejected=len(wedged))
+        log.warning("pipeline re-meshed (%s): n_shards=%d mesh_shards=%d "
+                    "min_bucket=%d; %d wedged ticket(s) rejected",
+                    reason, n_shards, self._mesh_shards, self._min_bucket,
+                    len(wedged))
+        return {"n_shards": self._n_shards,
+                "mesh_shards": self._mesh_shards,
+                "min_bucket": self._min_bucket,
+                "rejected": len(wedged)}
+
+    def _start_generation(self, new_gen: int) -> None:
+        """Start a fresh worker for ``new_gen`` (remesh path — no restart
+        budget, no backoff) with the canary dispatch pending; clears
+        ``_restarting`` either way."""
+        with self._lock:
+            if not (self._closing or self._closed or self._gen != new_gen):
+                self._worker = threading.Thread(
+                    target=self._run, args=(new_gen,), daemon=True,
+                    name=f"{self._name}-worker-g{new_gen}")
+                self._worker_gen = new_gen
+                self._cold_dispatch = True
+                self._canary_pending = True
+                self._worker.start()
+            self._restarting = False
+            self._cond.notify_all()
+        self._set_state_gauge()
+
+    def _maybe_canary(self, gen: int) -> None:
+        """A restarted/re-meshed worker's first act: prove the device path
+        with a synthetic all-invalid dispatch BEFORE serving traffic — a
+        recovery that immediately wedges again must never eat a real
+        submission to find out. The batch carries a ``_canary`` marker
+        column so the engine's dispatch closure skips its observers (flow
+        log, parity auditor, CT fingerprints). Success closes the half-open
+        breaker the same way a real dispatch would; failure feeds the
+        breaker — or the device-loss park path — with zero tickets harmed.
+        The canary does not count as a dispatched/completed batch."""
+        with self._lock:
+            if not self._canary_pending or gen != self._gen:
+                return
+            self._canary_pending = False
+        rows = self._n_shards if self._n_shards > 1 else 1
+        batch = empty_batch(rows)
+        batch["_canary"] = np.ones(rows, dtype=np.uint8)
+        now = int(time.time())
+        try:
+            self._hb_arm("canary", gen, grace=COLD_DISPATCH_GRACE)
+            self._check_gen(gen)
+            if self._n_shards > 1:
+                finalize = self._dispatch_fn(batch, now, None)
+            else:
+                finalize = self._dispatch_fn(batch, now)
+            finalize()
+            self._hb_clear(gen)
+            self._check_gen(gen)
+        except _Superseded:
+            raise
+        except DeviceLost as e:
+            self._hb_clear(gen)
+            self._check_gen(gen)
+            self.metrics.inc_counter("pipeline_canary_failed_total")
+            log.warning("pipeline canary (gen %d) lost device %d: %s",
+                        gen, e.device, e)
+            self._handle_device_lost(e, (), None)
+            return
+        except Exception as e:   # noqa: BLE001 — counted; breaker owns it
+            self._hb_clear(gen)
+            self._check_gen(gen)
+            self.metrics.inc_counter("pipeline_canary_failed_total")
+            self.breaker.record_failure()
+            log.warning("pipeline canary (gen %d) failed: %s", gen, e)
+            return
+        self.metrics.inc_counter("pipeline_canary_ok_total")
+        if self.breaker.state != "closed":
+            self.breaker.record_success()
+        self._cold_dispatch = False
 
     def _shed(self, ticket: Ticket, reason: str,
               exc: Optional[BaseException] = None) -> None:
@@ -1341,6 +1569,7 @@ class Pipeline:
             self._on_worker_crash(gen)
 
     def _run_inner(self, gen: int) -> None:
+        self._maybe_canary(gen)
         while True:
             sub = None
             action = None
@@ -1348,6 +1577,14 @@ class Pipeline:
                 while True:
                     if gen != self._gen or self._closed:
                         return
+                    if self._device_lost is not None and not self._closing:
+                        # device-lost park: do NOT pop the queue — queued
+                        # submissions must survive until Pipeline.remesh()
+                        # supersedes this generation and a fresh worker
+                        # serves them on the survivor mesh. (During close
+                        # we fall through so shutdown can still sweep.)
+                        self._cond.wait(0.25)
+                        continue
                     if self._queue:
                         sub = self._queue.popleft()
                         # hand-off under the lock: the sub must never be
@@ -1745,6 +1982,12 @@ class Pipeline:
                     self._dispatching = []
                     return
                 time.sleep(min(0.05, 0.0005 * (1 << min(attempts, 7))))
+            except DeviceLost as e:
+                self._hb_clear(gen)
+                self._check_gen(gen)
+                self._handle_device_lost(e, slices, buf_idx)
+                self._dispatching = []
+                return
             except Exception as e:   # noqa: BLE001 — supervised degradation
                 self._hb_clear(gen)
                 self._check_gen(gen)
@@ -1791,6 +2034,12 @@ class Pipeline:
                     self.tracer.span(tid, "pipeline.finalize"):
                 out = inf.finalize()
             self._hb_clear(gen)
+        except DeviceLost as e:
+            self._hb_clear(gen)
+            self._check_gen(gen)
+            self._handle_device_lost(e, inf.slices, inf.buf_idx)
+            self._finalizing = None      # settled above
+            return
         except Exception as e:   # noqa: BLE001 — incl. injected trips
             self._hb_clear(gen)
             self._check_gen(gen)

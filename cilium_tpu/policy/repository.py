@@ -140,6 +140,18 @@ class Repository:
             obs(rev)
         return rev
 
+    def bump_revision(self) -> int:
+        """Advance the revision with NO rule change and NO observer
+        notification — the re-mesh fence (ISSUE 19). A mesh geometry
+        change invalidates every revision-stamped steering pre-bin (the
+        feeder's ``_shard`` column hashes mod the OLD flow-axis width);
+        bumping here makes the stale stamps visibly stale. The caller owns
+        the forced recompile — going through the observers would only
+        debounce it behind the regen trigger."""
+        with self._lock:
+            self._revision += 1
+            return self._revision
+
     def _record(self, kind: str, rule: Rule) -> None:
         """Changelog append (pre-bump: records carry the revision the change
         will land in)."""

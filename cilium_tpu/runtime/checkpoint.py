@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import tempfile
+import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Dict, Optional
 from zipfile import BadZipFile       # np.load raises this on torn archives
@@ -286,6 +287,86 @@ def restore(engine: Engine, path: str, strict: bool = False) -> bool:
         engine.load_ct_arrays(ct)
     engine.regenerate(force=True)
     return True
+
+
+# --------------------------------------------------------------------------- #
+# CT snapshot archive (ISSUE 19): the ct-snapshot controller's bounded-
+# staleness conntrack archive — the salvage FLOOR a device-loss re-mesh
+# falls back to when the device gather itself fails (the chip died holding
+# the collective). Same atomic-write + self-describing-format discipline as
+# the full checkpoint's ct.npz; a directory of timestamped archives pruned
+# to a small keep count.
+# --------------------------------------------------------------------------- #
+CT_ARCHIVE_PREFIX = "ct-"
+CT_ARCHIVE_SUFFIX = ".npz"
+
+
+def list_ct_archives(dirpath: str) -> list:
+    """Archive paths, oldest → newest (timestamped names sort)."""
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.startswith(CT_ARCHIVE_PREFIX)
+                       and n.endswith(CT_ARCHIVE_SUFFIX))
+    except OSError:
+        return []
+    return [os.path.join(dirpath, n) for n in names]
+
+
+def newest_ct_archive(dirpath: str) -> Optional[str]:
+    paths = list_ct_archives(dirpath)
+    return paths[-1] if paths else None
+
+
+def ct_archive_age_s(dirpath: str,
+                     now: Optional[float] = None) -> Optional[float]:
+    """Age of the newest archive in seconds (mtime-based — survives a
+    process restart, unlike an in-memory stamp); None when no archive
+    exists yet."""
+    newest = newest_ct_archive(dirpath)
+    if newest is None:
+        return None
+    try:
+        mtime = os.stat(newest).st_mtime
+    except OSError:
+        return None
+    return max(0.0, (now if now is not None else time.time()) - mtime)
+
+
+def save_ct_archive(dirpath: str, arrays: Dict[str, np.ndarray],
+                    keep: int = 2) -> str:
+    """Write one timestamped CT archive atomically and prune the directory
+    to the ``keep`` newest. Returns the written path."""
+    os.makedirs(dirpath, exist_ok=True)
+    from cilium_tpu.runtime.datapath import CT_FORMAT_VERSION
+    name = f"{CT_ARCHIVE_PREFIX}{time.time_ns()}{CT_ARCHIVE_SUFFIX}"
+    dst = os.path.join(dirpath, name)
+    _atomic_write(dst,
+                  lambda f: np.savez_compressed(
+                      f, __ct_format__=np.int32(CT_FORMAT_VERSION),
+                      **arrays),
+                  ".ct-archive-")
+    for stale in list_ct_archives(dirpath)[:-max(1, keep)]:
+        try:
+            os.unlink(stale)
+        except OSError:   # noqa: BLE001 — pruning is best-effort
+            pass
+    return dst
+
+
+def load_ct_archive(path: str) -> Optional[Dict[str, np.ndarray]]:
+    """Read one CT archive; corruption degrades to None (the re-mesh then
+    falls through to a cold table — CT is a droppable cache, never worth
+    failing a salvage)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        with np.load(io.BytesIO(raw)) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+        from cilium_tpu.runtime.datapath import normalize_ct_arrays
+        return normalize_ct_arrays(arrays)
+    except (OSError, ValueError, BadZipFile) as e:
+        log.warning("CT archive %s unreadable (%s); dropping", path, e)
+        return None
 
 
 # --------------------------------------------------------------------------- #

@@ -231,7 +231,7 @@ class ClusterNode:
     def stop(self, timeout: float = 60.0) -> None:
         try:
             self.call("stop", timeout=timeout)
-        except Exception:
+        except Exception:   # noqa: BLE001 — best-effort stop RPC; terminate() below is the backstop
             pass
         self.proc.join(timeout=timeout)
         if self.proc.is_alive():
@@ -352,5 +352,5 @@ class ClusterSupervisor:
             else:
                 try:
                     node._conn.close()
-                except Exception:
+                except Exception:   # noqa: BLE001 — closing a pipe to a dead node; nothing to account
                     pass

@@ -251,6 +251,34 @@ class DaemonConfig:
     # class): oldest-expiry eviction past either cap; 0 = unbounded
     fqdn_max_names: int = 4096
     fqdn_max_ips_per_name: int = 64
+    # --- mesh self-healing (ISSUE 19; runtime/datapath.remesh + the
+    # engine's mesh-heal controller) ---
+    # remesh_enabled arms the recovery path on sharded JIT backends: a
+    # dead-device dispatch signature (DeviceLost) fences the pipeline
+    # generation, shrinks the mesh to the surviving devices, salvages the
+    # surviving shards' CT, and resumes degraded — and a healed device
+    # (probe canary passing for remesh_heal_hysteresis_s) re-meshes back
+    # to full width. Off: device loss stays breaker/restart territory.
+    remesh_enabled: bool = True
+    remesh_interval_s: float = 0.5      # mesh-heal controller poll
+    # bounded established-fingerprint grace window after a LOSS remesh:
+    # the lost shard's flows classify NEW on-device (their CT is gone)
+    # but recently-applied established verdicts flip back to allow at
+    # verdict-apply, counted ct_salvage_grace_hits_total, until the
+    # window closes and cold-learned CT has taken over
+    remesh_grace_s: float = 30.0
+    # a healed device must hold a passing probe canary this long before
+    # the reverse remesh (anti-flap hysteresis); each failed probe resets
+    remesh_heal_hysteresis_s: float = 10.0
+    # --- ct-snapshot controller (bounded-staleness CT archive: the
+    # salvage floor when the remesh gather itself fails) ---
+    ct_snapshot_dir: str = ""           # archive directory ("" = disabled)
+    ct_snapshot_interval_s: float = 30.0
+    ct_snapshot_keep: int = 2           # newest archives retained
+    # checkpoint freshness budget: newest CT archive older than this →
+    # checkpoint_age_seconds gauge + CHECKPOINT_STALE health detail
+    # (0 = no freshness contract)
+    checkpoint_max_age_s: float = 0.0
 
     def __post_init__(self):
         if self.enforcement_mode not in C.ENFORCEMENT_MODES:
@@ -399,6 +427,20 @@ class DaemonConfig:
         if self.fqdn_max_names < 0 or self.fqdn_max_ips_per_name < 0:
             raise ValueError("fqdn_max_names and fqdn_max_ips_per_name "
                              "must be >= 0 (0 = unbounded)")
+        if self.remesh_interval_s <= 0:
+            raise ValueError("remesh_interval_s must be > 0")
+        if self.remesh_grace_s < 0:
+            raise ValueError("remesh_grace_s must be >= 0 (0 = no grace "
+                             "window)")
+        if self.remesh_heal_hysteresis_s < 0:
+            raise ValueError("remesh_heal_hysteresis_s must be >= 0")
+        if self.ct_snapshot_interval_s <= 0:
+            raise ValueError("ct_snapshot_interval_s must be > 0")
+        if self.ct_snapshot_keep < 1:
+            raise ValueError("ct_snapshot_keep must be >= 1")
+        if self.checkpoint_max_age_s < 0:
+            raise ValueError("checkpoint_max_age_s must be >= 0 "
+                             "(0 = no freshness contract)")
         if self.qos_enabled or self.qos_tenants or self.qos_assign:
             # parse eagerly so a malformed spec fails at config load, not
             # mid-flood inside the admission path

@@ -24,8 +24,10 @@ the test that the boundary is real.
 from __future__ import annotations
 
 import abc
+import re
 import threading
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -33,6 +35,7 @@ from cilium_tpu.compile.ct_layout import CTConfig, make_ct_arrays
 from cilium_tpu.compile.snapshot import PolicySnapshot
 from cilium_tpu.observe.trace import (CT_GC_SPAN, PATCH_APPLY_SPAN,
                                       active as active_trace)
+from cilium_tpu.pipeline.guard import DeviceLost
 from cilium_tpu.runtime.config import DaemonConfig
 from cilium_tpu.runtime.faults import FAULTS
 from cilium_tpu.utils import constants as C
@@ -61,6 +64,43 @@ class StalePlacement(RuntimeError):
     against a torn or deleted image. Callers retry with the engine's
     current active snapshot; semantically identical to having dispatched a
     moment later."""
+
+
+#: substrings that mark a dispatch exception as a DEAD-ACCELERATOR failure
+#: rather than the transient dispatch errors the breaker/backoff machinery
+#: owns. The first entry is the chaos drill (runtime/faults.py
+#: ``device.fail``); the rest are the signatures real runtimes emit when a
+#: chip drops off the bus (PJRT/XLA status strings, the ICI-link variants a
+#: pod slice reports when a neighbor dies). Matching is case-sensitive on
+#: purpose: these are literal runtime status tokens, and loosening the
+#: match risks classifying a user exception that merely *mentions* devices.
+_DEAD_DEVICE_MARKERS = (
+    "device.fail",
+    "DEVICE_UNAVAILABLE",
+    "device unavailable",
+    "Device or resource busy",
+    "hardware failure",
+    "data transfer failed between devices",
+    "chip has been disabled",
+)
+
+#: ``dev=K`` riding in the exception text attributes the loss to a flow-
+#: shard ordinal (the fault drill arms it via message=dev=K; a real
+#: runtime's status may or may not name the chip)
+_DEAD_DEVICE_ORDINAL = re.compile(r"\bdev=(\d+)\b")
+
+
+def dead_device_of(exc: BaseException) -> Optional[int]:
+    """Classify a dispatch exception: ``None`` means transient (breaker /
+    retry territory — NOT a device loss), an int means a dead-accelerator
+    signature. The int is the flow-shard ordinal the failure names, or -1
+    when the signature carries no attribution (the caller then treats the
+    whole mesh generation as suspect and probes)."""
+    text = f"{type(exc).__name__}: {exc}"
+    if not any(m in text for m in _DEAD_DEVICE_MARKERS):
+        return None
+    m = _DEAD_DEVICE_ORDINAL.search(text)
+    return int(m.group(1)) if m else -1
 
 
 class PlacedTensors(dict):
@@ -265,6 +305,15 @@ class JITDatapath(DatapathBackend):
                 raise ValueError("n_shards must be a power of two (each CT "
                                  "shard is a power-of-two hash table)")
             self._mesh = make_mesh(self.n_flow_shards, self.n_rule_shards)
+            # mesh self-healing (ISSUE 19): flow shard i serves on row i of
+            # this CONFIGURED device grid for the life of the process —
+            # remesh() re-derives survivor meshes from it and device-health
+            # ordinals index into it. The grid never shrinks; only
+            # _live_ordinals (the serving subset) does.
+            self._configured_devices = [
+                list(row) for row in np.asarray(self._mesh.devices).reshape(
+                    self.n_flow_shards, self.n_rule_shards)]
+            self._live_ordinals: List[int] = list(range(self.n_flow_shards))
             self._ct_sharding = NamedSharding(self._mesh, P("flows"))
             self._repl_sharding = NamedSharding(self._mesh, P())
             # packed wire rows shard over 'flows': each chip receives only
@@ -284,6 +333,15 @@ class JITDatapath(DatapathBackend):
                 donate_ct=self.config.donate_ct,
                 fused=self._fused,
                 fused_interpret=self._fused_interpret)
+            # per-survivor-set geometry cache: healing back onto a device
+            # set the process already served reuses that set's mesh +
+            # jitted classify — re-tracing on every down/up flap would
+            # stall serving for seconds each transition
+            self._mesh_cache: Dict[Tuple[int, ...], tuple] = {
+                tuple(self._live_ordinals): (
+                    self._mesh, self._ct_sharding, self._repl_sharding,
+                    self._batch_sharding, self._verdict_sharding,
+                    self._classify)}
         else:
             from cilium_tpu.kernels.classify import make_classify_fn
             self._ct = {k: jnp.asarray(v) for k, v in ct_host.items()}
@@ -297,6 +355,28 @@ class JITDatapath(DatapathBackend):
                 packed=True,
                 fused=self._fused,
                 fused_interpret=self._fused_interpret)
+            self._configured_devices = None
+            self._live_ordinals = [0]
+            self._mesh_cache = {}
+        # the flow-shard width the operator CONFIGURED; n_flow_shards is
+        # the width currently SERVING (remesh shrinks/restores it)
+        self._configured_flow_shards = self.n_flow_shards
+        # per-ordinal health records latched by the dead-device classifier
+        # and cleared on heal — the engine folds these into health() and
+        # the mesh_width ledger row
+        self.device_health: Dict[int, Dict[str, Any]] = {}
+        # CT capacity currently on device: remesh clamps it to the largest
+        # per-shard power of two the survivor count divides into
+        # (parallel/mesh.degraded_ct_capacity) — sweep cursors and restores
+        # must use THIS, not config.ct_capacity
+        self._ct_capacity = int(self.config.ct_capacity)
+        self.remesh_stats: Dict[str, int] = {
+            "remesh_total": 0,
+            "remesh_ct_salvaged": 0,     # live entries carried across
+            "remesh_ct_lost": 0,         # live entries on lost shards
+            "remesh_ct_dropped": 0,      # rehash probe-window casualties
+            "remesh_gather_failures": 0,  # device.collective / gather died
+        }
         # donated CT buffers make concurrent classify a use-after-donate;
         # serialize the device step (host-side controllers may call in)
         self._ct_lock = threading.Lock()
@@ -663,7 +743,7 @@ class JITDatapath(DatapathBackend):
                         try:
                             new_placed["verdict"] = self._scatter_rows(
                                 placed["verdict"], rows_dev, vals_dev)
-                        except Exception:
+                        except Exception:   # noqa: BLE001 — accounted below
                             # the donation may already have consumed the
                             # old buffer AND the handle is marked dead: a
                             # raise here would leave regenerate()'s
@@ -1005,6 +1085,7 @@ class JITDatapath(DatapathBackend):
                              shards=self.n_flow_shards):
                 FAULTS.fire("datapath.transfer")
                 FAULTS.fire("ct.insert")
+                self._fire_device_fault()
                 if dict_batch is not None:
                     dev_batch = dict_batch   # the jit shards the columns
                 elif path_dict is not None:
@@ -1018,8 +1099,9 @@ class JITDatapath(DatapathBackend):
                         dict(placed), self._ct, dev_batch, jnp.uint32(now),
                         jnp.int32(snap.world_index))
                     self._ct = new_ct
-        except BaseException:
+        except BaseException as e:
             self._wire_buf_shed(wire_key)    # finalize will never run
+            self._maybe_device_lost(e)       # dead-chip signature? reclassify
             raise
 
         def finalize():
@@ -1029,8 +1111,9 @@ class JITDatapath(DatapathBackend):
                     out_np = {k: np.asarray(v) for k, v in out.items()}
                     counters_np = {k: np.asarray(v)
                                    for k, v in counters.items()}
-            except BaseException:
+            except BaseException as e:
                 self._wire_buf_shed(wire_key)  # failed materialization
+                self._maybe_device_lost(e)
                 raise
             if wire_key is not None:
                 self._wire_buf_release(wire_key, wire_buf)
@@ -1098,6 +1181,7 @@ class JITDatapath(DatapathBackend):
                              shards=n):
                 FAULTS.fire("datapath.transfer")
                 FAULTS.fire("ct.insert")
+                self._fire_device_fault()
                 if dict_batch is not None:
                     dev_batch = dict_batch   # the jit shards the columns
                 elif path_dict is not None:
@@ -1111,8 +1195,9 @@ class JITDatapath(DatapathBackend):
                         dict(placed), self._ct, dev_batch, jnp.uint32(now),
                         jnp.int32(snap.world_index))
                     self._ct = new_ct
-        except BaseException:
+        except BaseException as e:
             self._wire_buf_shed(wire_key)    # finalize will never run
+            self._maybe_device_lost(e)       # dead-chip signature? reclassify
             raise
 
         def finalize():
@@ -1122,8 +1207,9 @@ class JITDatapath(DatapathBackend):
                     out_np = {k: np.asarray(v) for k, v in out.items()}
                     counters_np = {k: np.asarray(v)
                                    for k, v in counters.items()}
-            except BaseException:
+            except BaseException as e:
                 self._wire_buf_shed(wire_key)  # failed materialization
+                self._maybe_device_lost(e)
                 raise
             if wire_key is not None:
                 self._wire_buf_release(wire_key, wire_buf)
@@ -1192,7 +1278,10 @@ class JITDatapath(DatapathBackend):
             self._gc_pending = None
         else:
             reclaimed = 0
-        cap = int(self.config.ct_capacity)
+        # the LIVE capacity: a remesh clamps the table to the survivor
+        # count's power-of-two geometry, and a cursor wrapping on the
+        # configured size would sweep past the end of the shrunken table
+        cap = int(self._ct_capacity)
         tracer, trace_id = active_trace()
         with tracer.span(trace_id, CT_GC_SPAN,
                          cursor=self._gc_cursor, chunk=chunk_rows):
@@ -1240,7 +1329,7 @@ class JITDatapath(DatapathBackend):
         # may come from a different shard count or the dense fake export
         arrays, dropped = rehash_ct_arrays(
             arrays, self.n_flow_shards, self.config.probe_depth,
-            capacity=self.config.ct_capacity)
+            capacity=self._ct_capacity)
         if dropped:
             logging.getLogger("cilium_tpu.datapath").warning(
                 "load_ct_arrays: %d entries dropped (probe window exhausted "
@@ -1254,6 +1343,230 @@ class JITDatapath(DatapathBackend):
             else:
                 self._ct = {k: jnp.asarray(v) for k, v in arrays.items()}
         self._account_ct_hbm()
+
+    # -- mesh self-healing (ISSUE 19: device loss → fenced re-mesh onto
+    # survivors → CT salvage → hysteretic re-admission) ----------------------
+    def _fire_device_fault(self) -> None:
+        """The ``device.fail`` drill, fired on every sharded dispatch. A
+        trip naming an ordinal that already LEFT the serving mesh is
+        swallowed: the dead chip cannot hurt a mesh it is no longer part
+        of — that swallow is what lets degraded serving run with the fault
+        still armed (disarming the point is the drill's heal signal). Trips
+        naming a live ordinal, or naming none, propagate into the dispatch
+        failure path where :meth:`_maybe_device_lost` reclassifies them."""
+        try:
+            FAULTS.fire("device.fail")
+        except BaseException as e:
+            dev = dead_device_of(e)
+            if dev is not None and dev >= 0 \
+                    and dev not in self._live_ordinals:
+                return
+            raise
+
+    def _maybe_device_lost(self, exc: BaseException) -> None:
+        """Dispatch-failure triage (the detection half of ISSUE 19):
+        transient errors return untouched — the caller's breaker/backoff
+        machinery owns those — while a dead-accelerator signature latches
+        the per-device health record and re-raises as
+        :class:`~cilium_tpu.pipeline.guard.DeviceLost`, the signal the
+        pipeline parks its worker on and the engine re-meshes from."""
+        if isinstance(exc, DeviceLost):
+            raise exc
+        dev = dead_device_of(exc)
+        if dev is None:
+            return
+        self.note_device_loss(dev, reason=str(exc))
+        raise DeviceLost(
+            f"dead-device signature in sharded dispatch: {exc}",
+            device=dev) from exc
+
+    def note_device_loss(self, ordinal: int, reason: str = "") -> None:
+        """Latch a per-device health record. The FIRST loss's evidence is
+        kept (a storm of failures off one dead chip must not churn the
+        record the debug bundle will cite); an unattributed loss (-1)
+        records nothing — the engine's probe pass owns attribution then."""
+        if ordinal < 0 or ordinal >= self._configured_flow_shards:
+            return
+        rec = self.device_health.get(ordinal)
+        if rec is not None and rec.get("state") == "dead":
+            return
+        self.device_health[ordinal] = {
+            "state": "dead", "since": time.time(),
+            "reason": str(reason)[:200]}
+
+    def note_device_healed(self, ordinal: int) -> None:
+        rec = self.device_health.get(ordinal)
+        if rec is not None:
+            rec.update(state="live", since=time.time(), reason="")
+
+    def probe_device(self, ordinal: int) -> bool:
+        """Heal canary for one CONFIGURED chip: the chaos drill first (an
+        armed ``device.fail`` naming this ordinal — or attributing to no
+        ordinal at all — means still dead), then a real host→device round
+        trip against the chip itself. Never raises: a failed probe IS the
+        answer."""
+        try:
+            FAULTS.fire("device.fail")
+        except BaseException as e:   # noqa: BLE001 — trip text is the verdict
+            dev = dead_device_of(e)
+            if dev is None or dev < 0 or dev == ordinal:
+                return False
+        if self._configured_devices is None:
+            return True
+        try:
+            import jax
+            dev0 = self._configured_devices[ordinal][0]
+            np.asarray(jax.device_put(np.ones(8, np.uint8), dev0))
+        except Exception:   # noqa: BLE001 — a failed probe IS the answer
+            return False
+        return True
+
+    def mesh_health(self) -> Dict[str, Any]:
+        """Operator-facing mesh-width surface: configured vs currently
+        SERVING flow shards, which ordinals serve, and the per-device
+        health records — what ``Engine.health()`` and the ``mesh_width``
+        resource-ledger row render."""
+        dead = sorted(o for o, r in self.device_health.items()
+                      if r.get("state") == "dead")
+        return {
+            "configured": self._configured_flow_shards,
+            "live": self.n_flow_shards if self._sharded else 1,
+            "live_ordinals": list(self._live_ordinals),
+            "dead_ordinals": dead,
+            "devices": {int(k): dict(v)
+                        for k, v in self.device_health.items()},
+        }
+
+    def remesh(self, live_ordinals, fence_handle=None,
+               salvage_floor: Optional[Dict[str, np.ndarray]] = None
+               ) -> Dict[str, Any]:
+        """Shrink (or re-grow) the serving mesh to exactly the given
+        CONFIGURED flow-shard ordinals, salvaging the conntrack table
+        across the transition. Runs under the classify lock — the caller
+        (Engine._remesh_to) has already fenced the pipeline generation, so
+        nothing is dispatching concurrently; a racing CONTROL-PLANE
+        classify that captured the pre-remesh placed handle hits the
+        ``fence_handle.dead`` flip and retries via StalePlacement.
+
+        CT salvage order (each fallback counted in ``remesh_stats``):
+        device gather (``device.collective`` is the chaos point) → the
+        caller's ``salvage_floor`` archive (the ct-snapshot controller's
+        bounded-staleness npz) → a cold table. On a successful gather the
+        LOST shards' slots are zeroed first: on real hardware that state
+        died with the chip, and the CPU rig must not get a free pass the
+        grace window was built to cover.
+
+        The new table's capacity is ``degraded_ct_capacity`` — the largest
+        per-shard power of two the survivor count divides into — and
+        surviving entries rehash into it (probe-window casualties counted
+        ``remesh_ct_dropped``). The caller re-places the active snapshot
+        onto the new mesh afterwards."""
+        if not self._sharded or self._configured_devices is None:
+            raise ValueError("remesh requires a flow-sharded mesh")
+        live = sorted({int(o) for o in live_ordinals})
+        if not live:
+            raise ValueError("remesh needs at least one surviving shard")
+        bad = [o for o in live
+               if not 0 <= o < self._configured_flow_shards]
+        if bad:
+            raise ValueError(f"remesh ordinals out of range: {bad}")
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from cilium_tpu.parallel.mesh import (
+            degraded_ct_capacity, drop_ct_shard, make_mesh,
+            make_sharded_classify_fn, make_unsteered_classify_fn,
+            rehash_ct_arrays, shard_ct_arrays)
+        n_new = len(live)
+        started = time.monotonic()
+        with self._ct_lock:
+            old_live = list(self._live_ordinals)
+            old_n = len(old_live)
+            if live == old_live:
+                return {"from": old_n, "to": n_new, "noop": True,
+                        "live_ordinals": live}
+            # 1) salvage: gather the current table to host. The gather is
+            # itself a collective over a possibly-degraded mesh — its
+            # failure (chaos: device.collective) falls back to the archive
+            # floor, then cold.
+            source = "device"
+            arrays: Optional[Dict[str, np.ndarray]] = None
+            try:
+                FAULTS.fire("device.collective")
+                # np.array (not asarray): the gather view off a jax buffer
+                # is read-only, and the lost-shard zeroing below mutates
+                arrays = {k: np.array(v) for k, v in self._ct.items()}
+            except Exception:   # noqa: BLE001 — counted, archive/cold floor
+                self.remesh_stats["remesh_gather_failures"] += 1
+            lost = 0
+            if arrays is not None:
+                for pos, o in enumerate(old_live):
+                    if o not in live:
+                        lost += drop_ct_shard(arrays, pos, old_n)
+            elif salvage_floor is not None:
+                source = "archive"
+                arrays = {k: np.array(v) for k, v in salvage_floor.items()}
+            else:
+                source = "cold"
+                arrays = make_ct_arrays(CTConfig(self.config.ct_capacity,
+                                                 self.config.probe_depth))
+            new_cap = degraded_ct_capacity(self.config.ct_capacity, n_new)
+            arrays, dropped = rehash_ct_arrays(
+                arrays, n_new, self.config.probe_depth, capacity=new_cap)
+            salvaged = int((arrays["expiry"] > 0).sum())
+            # 2) survivor geometry, cached per exact device set: healing
+            # back onto a set that served before reuses its jitted classify
+            key = tuple(live)
+            cached = self._mesh_cache.get(key)
+            if cached is None:
+                devices = [d for o in live
+                           for d in self._configured_devices[o]]
+                mesh = make_mesh(n_new, self.n_rule_shards, devices=devices)
+                make_fn = (make_unsteered_classify_fn if self._rss_device
+                           else make_sharded_classify_fn)
+                cached = (
+                    mesh,
+                    NamedSharding(mesh, P("flows")),
+                    NamedSharding(mesh, P()),
+                    NamedSharding(mesh, P("flows")),
+                    NamedSharding(mesh, P(None, None, "rules", None)),
+                    make_fn(mesh,
+                            probe_depth=self.config.probe_depth,
+                            v4_only=self.config.v4_only,
+                            donate_ct=self.config.donate_ct,
+                            fused=self._fused,
+                            fused_interpret=self._fused_interpret))
+                self._mesh_cache[key] = cached
+            (self._mesh, self._ct_sharding, self._repl_sharding,
+             self._batch_sharding, self._verdict_sharding,
+             self._classify) = cached
+            shard_ct_arrays(arrays, n_new)    # divisibility fail-fast
+            self._ct = {k: jax.device_put(v, self._ct_sharding)
+                        for k, v in arrays.items()}
+            self.n_flow_shards = n_new
+            self._live_ordinals = live
+            self._ct_capacity = new_cap
+            # the overlapped GC's jitted chunk sweep and the donated
+            # verdict scatter both baked the OLD geometry — drop them and
+            # restart the sweep cursor in the new slot space
+            self._gc_fn = None
+            self._gc_cursor = 0
+            self._gc_pending = None
+            self._scatter_fn = None
+            self.remesh_stats["remesh_total"] += 1
+            self.remesh_stats["remesh_ct_salvaged"] += salvaged
+            self.remesh_stats["remesh_ct_lost"] += lost
+            self.remesh_stats["remesh_ct_dropped"] += dropped
+            # fence: flips atomically with the geometry swap, so a control-
+            # plane classify holding the old handle can never enqueue
+            # against the old mesh's shardings
+            if isinstance(fence_handle, PlacedTensors):
+                fence_handle.dead = True
+        self._account_ct_hbm()
+        return {"from": old_n, "to": n_new, "live_ordinals": live,
+                "ct_capacity": new_cap, "ct_salvaged": salvaged,
+                "ct_lost": lost, "ct_dropped": dropped,
+                "salvage_source": source,
+                "took_s": round(time.monotonic() - started, 3)}
 
 
 class FakeDatapath(DatapathBackend):

@@ -208,6 +208,17 @@ class Engine:
         # CT emergency-GC latch (hysteresis: enters at ct_pressure_high,
         # exits at ct_pressure_low; armed by sweep()/sweep_step())
         self._ct_emergency = False
+        # mesh self-healing (ISSUE 19): device loss → fenced re-mesh onto
+        # survivors → CT salvage → hysteretic re-admission. The grace-
+        # window fingerprint filter shares the feeder's exact established-
+        # flow update/lookup discipline (shim/feeder.py) but is engine-
+        # owned: the window must work for direct submit() producers too.
+        from cilium_tpu.shim.feeder import EstablishedFingerprints
+        self._remesh_lock = threading.Lock()
+        self._remesh_last: Optional[Dict] = None
+        self._heal_ok_since: Optional[float] = None   # probe-pass streak
+        self._salvage_until = 0.0    # monotonic end of the grace window
+        self._salvage_fp = EstablishedFingerprints()
 
         self._regen_trigger = Trigger(self._mark_dirty_and_regen,
                                       min_interval=self.config.regen_debounce_s,
@@ -738,6 +749,16 @@ class Engine:
                     mesh_shards=mesh_shards,
                     rss_mode=rss_mode,
                     event_sink=self._pipeline_event,
+                    # device-loss park protocol (ISSUE 19): a DeviceLost
+                    # dispatch failure parks the worker and signals here
+                    # instead of spending restart budget on a chip that
+                    # will not come back; the mesh-heal controller answers
+                    # with the fenced re-mesh. Unsharded (or healing
+                    # disabled): no callback — DeviceLost degrades to the
+                    # breaker path like any other dispatch failure.
+                    on_device_loss=self._on_device_loss
+                    if self._pipeline_sharded and cfg.remesh_enabled
+                    else None,
                     qos=self.qos,
                     # the lane shape must stay a valid bucket: within
                     # [1, min_bucket] and, on a device-RSS mesh, still
@@ -857,6 +878,13 @@ class Engine:
 
         def finalize():
             out, counters = fin()
+            if batch.get("_canary") is not None:
+                # scheduler recovery canary (ISSUE 19): synthetic
+                # all-invalid rows proving the datapath round-trips after
+                # a restart/re-mesh — invisible to every accounting
+                # surface (no metrics, flow log, observers, or grace-
+                # window learning)
+                return out
             n_valid = int(np.asarray(batch["valid"]).sum())
             self.metrics.add_batch(counters, n_valid)
             self.flowlog.append_batch(batch, out, now,
@@ -867,7 +895,11 @@ class Engine:
             # before the scheduler recycles the buffer
             self._observe_batch(batch, out, active.snapshot, now, n_valid,
                                 steered=self._pipeline_sharded)
-            return out
+            # CT-salvage grace window (ISSUE 19): strictly AFTER the
+            # audit capture — the auditor judges the datapath's raw
+            # verdict (oracle parity must stay exact), while the APPLIED
+            # verdict rides the bounded established-fingerprint grace
+            return self._ct_salvage_apply(batch, out)
         return finalize
 
     # -- async shim ingestion (shim/feeder.py) ----------------------------------
@@ -945,6 +977,244 @@ class Engine:
             self.metrics.set_gauge("ct_emergency_gc", 0)
             self.blackbox.record_event("ct-emergency", action="exit",
                                        occupancy=round(occ_frac, 4))
+
+    # -- mesh self-healing (ISSUE 19): device-loss detection → fenced
+    # re-mesh onto survivors → CT salvage → hysteretic re-admission ----------
+    def _on_device_loss(self, device: int, reason: str) -> None:
+        """Pipeline → engine device-loss signal. Runs on the pipeline
+        worker mid-failure handling, so it must not call back into the
+        pipeline — the freezing ``device-loss`` flight-recorder event
+        already rode the event sink; here only the attribution counter
+        and the heal-hysteresis reset (a chip that just died restarts
+        its probe-pass streak from zero)."""
+        self.metrics.inc_counter(
+            f'device_loss_total{{device="{device}"}}')
+        self._heal_ok_since = None
+
+    def remesh_step(self) -> Optional[Dict]:
+        """One tick of the ``mesh-heal`` controller (directly callable
+        from the cfg10 bench/tests for deterministic driving).
+
+        DOWN: any latched-dead ordinal still in the serving set triggers
+        a fenced re-mesh onto the survivors — the wedged in-flight window
+        is rejected, queued submissions survive, CT salvages, and the
+        bounded established-fingerprint grace window arms.
+
+        UP: configured-but-departed ordinals are canary-probed
+        (``probe_device``: the chaos drill first, then a real host→device
+        round trip); only after every probe has passed continuously for
+        ``remesh_heal_hysteresis_s`` does the reverse re-mesh re-admit
+        them — a flapping chip re-zeroes the streak via
+        :meth:`_on_device_loss` and never thrashes the mesh."""
+        cfg = self.config
+        dp = self.datapath
+        mh = getattr(dp, "mesh_health", None)
+        if not cfg.remesh_enabled or mh is None:
+            return None
+        h = mh()
+        if h["configured"] <= 1:
+            return None
+        live = list(h["live_ordinals"])
+        dead = set(h["dead_ordinals"])
+        doc: Dict = {"configured": h["configured"], "live": len(live),
+                     "remesh": None}
+        dead_live = [o for o in live if o in dead]
+        if dead_live:
+            target = [o for o in live if o not in dead]
+            if not target:
+                # every shard latched dead: nothing to re-mesh onto —
+                # the parked pipeline's guard surface tells that story
+                doc["remesh"] = "no-survivors"
+                return doc
+            doc["remesh"] = self._remesh_to(target, reason="device-loss")
+            return doc
+        departed = [o for o in range(h["configured"]) if o not in live]
+        if not departed:
+            self._heal_ok_since = None
+            return doc
+        healthy = [o for o in departed if dp.probe_device(o)]
+        if not healthy:
+            self._heal_ok_since = None
+            return doc
+        now = time.monotonic()
+        if self._heal_ok_since is None:
+            self._heal_ok_since = now
+        doc["heal_ok_s"] = round(now - self._heal_ok_since, 3)
+        if now - self._heal_ok_since >= cfg.remesh_heal_hysteresis_s:
+            for o in healthy:
+                dp.note_device_healed(o)
+            self._heal_ok_since = None
+            doc["remesh"] = self._remesh_to(sorted(live + healthy),
+                                            reason="heal")
+        return doc
+
+    def _remesh_to(self, target, reason: str) -> Optional[Dict]:
+        """Fenced re-mesh to exactly the ``target`` ordinals: fence the
+        pipeline generation (wedged in-flight window rejected with an
+        attributable PipelineError, queued submissions survive), swap the
+        datapath mesh with CT salvage, then recompile and re-place the
+        snapshot onto the survivor mesh under a BUMPED revision — the
+        bump is the steering fence: every pre-binned ``_shard`` stamp
+        hashed mod the old flow-axis width becomes visibly stale and is
+        re-steered at stage-write. The scheduler adopts the returned
+        geometry (n_shards / mesh_shards / re-clamped min_bucket) and
+        restarts dispatch canary-first."""
+        dp = self.datapath
+        with self._remesh_lock:
+            old = int(dp.n_flow_shards)
+            result: Dict = {}
+
+            def rebuild():
+                with self._lock:
+                    active = self._active
+                    res = dp.remesh(
+                        target,
+                        fence_handle=active.tensors
+                        if active is not None else None,
+                        salvage_floor=self._ct_salvage_arrays())
+                    result.update(res)
+                    if not res.get("noop"):
+                        # the incremental compiler's patch path targets
+                        # the now-fenced placement: discard it and force
+                        # a full compile+place on the NEW mesh. A compile
+                        # failure here raises through — the scheduler
+                        # restarts the generation and the breaker narrates
+                        # the doubly-degraded state; serving the fenced
+                        # handle would only StalePlacement forever.
+                        self._inc = None
+                        self.repo.bump_revision()
+                        self._dirty_event.set()
+                        self._regenerate_locked(force=True)
+                new_mesh = int(dp.n_flow_shards)
+                min_bucket = min(self.config.pipeline_min_bucket,
+                                 self.config.batch_size)
+                rss = getattr(dp, "rss_state", None) or {}
+                if rss.get("mode") == "device":
+                    # every bucket must still divide the (new) flow axis
+                    min_bucket = max(min_bucket, new_mesh)
+                return {"n_shards": getattr(dp, "pipeline_shards", 1),
+                        "mesh_shards": new_mesh,
+                        "min_bucket": min_bucket}
+
+            pl = self._pipeline
+            if pl is not None:
+                geom = pl.remesh(rebuild, reason=reason)
+            else:
+                # no pipeline (classify-only engines, unit drills): the
+                # datapath swap alone is the whole fence
+                geom = rebuild()
+                self._pipeline_event(
+                    "remesh", ok=True, reason=reason,
+                    n_shards=geom["n_shards"],
+                    mesh_shards=geom["mesh_shards"])
+            if result.get("noop"):
+                return result
+            new = int(result.get("to", dp.n_flow_shards))
+            self.metrics.inc_counter(
+                f'datapath_remesh_total{{from="{old}",to="{new}"}}')
+            if new < old:
+                # the grace window arms only on the DEGRADE direction:
+                # healing back carries the whole salvaged table with it
+                self._salvage_until = time.monotonic() \
+                    + self.config.remesh_grace_s
+            self._remesh_last = {**result, "reason": reason,
+                                 "geometry": geom, "t": time.time()}
+            return self._remesh_last
+
+    def _ct_salvage_apply(self, batch: Dict[str, np.ndarray],
+                          out: Dict[str, np.ndarray]
+                          ) -> Dict[str, np.ndarray]:
+        """Post-audit verdict overlay for the bounded post-remesh grace
+        window. Every finalized batch feeds the established-fingerprint
+        filter (the window must be warm BEFORE a loss); while the window
+        is open, denied rows whose fingerprint was stamped established
+        pre-loss flip to allow — established flows ride over the lost
+        shard's CT while forward packets cold-learn entries on the
+        survivor mesh. Counted ``ct_salvage_grace_hits_total``; never
+        raises; copies on flip (the auditor holds the raw arrays)."""
+        try:
+            self._salvage_fp.note(batch, out)
+            if time.monotonic() >= self._salvage_until:
+                return out
+            allow = np.asarray(out["allow"])
+            m = (np.asarray(batch["valid"]) & ~allow
+                 & self._salvage_fp.hits(batch))
+            n = int(m.sum())
+            if not n:
+                return out
+            out = dict(out)
+            allow = allow.copy()
+            allow[m] = True
+            reason = np.asarray(out["reason"]).copy()
+            reason[m] = 0
+            out["allow"] = allow
+            out["reason"] = reason
+            self.metrics.inc_counter("ct_salvage_grace_hits_total", n)
+        except Exception:   # noqa: BLE001 — overlay, never load-bearing
+            log.exception("ct-salvage grace overlay failed")
+        return out
+
+    def _ct_salvage_arrays(self) -> Optional[Dict[str, np.ndarray]]:
+        """The archive salvage floor for a re-mesh whose device gather
+        fails (the chip died holding the collective): the newest
+        ct-snapshot archive, or None when the controller is off / has not
+        written one — the re-mesh then falls through to a cold table."""
+        d = self.config.ct_snapshot_dir
+        if not d:
+            return None
+        from cilium_tpu.runtime import checkpoint
+        newest = checkpoint.newest_ct_archive(d)
+        if newest is None:
+            return None
+        return checkpoint.load_ct_archive(newest)
+
+    def ct_snapshot_step(self, now: Optional[float] = None
+                         ) -> Optional[Dict]:
+        """One tick of the ``ct-snapshot`` controller: gather the CT
+        table to host and write one timestamped archive (atomic,
+        self-describing format, pruned to ``ct_snapshot_keep``) — the
+        bounded-staleness salvage floor. The ``device.collective`` chaos
+        point fails the gather here exactly like it fails a re-mesh
+        gather; controller supervision backs off, the archive ages, and
+        ``checkpoint_age_seconds`` / CHECKPOINT_STALE make the lost
+        redundancy visible (the ``finally`` keeps the age gauge honest
+        even on a failing tick; -1 = no archive yet)."""
+        cfg = self.config
+        if not cfg.ct_snapshot_dir:
+            return None
+        from cilium_tpu.runtime import checkpoint as ckpt
+        if now is None:
+            now = time.time()
+        doc = None
+        try:
+            FAULTS.fire("device.collective")
+            arrays = self.datapath.ct_arrays()
+            path = ckpt.save_ct_archive(cfg.ct_snapshot_dir, arrays,
+                                        keep=cfg.ct_snapshot_keep)
+            doc = {"path": path,
+                   "entries": int((arrays["expiry"] > 0).sum())}
+        finally:
+            age = ckpt.ct_archive_age_s(cfg.ct_snapshot_dir, now=now)
+            self.metrics.set_gauge(
+                "checkpoint_age_seconds",
+                round(age, 3) if age is not None else -1.0)
+        return doc
+
+    def remesh_status(self) -> Dict:
+        """Operator surface for the self-healing plane (``/v1/status`` /
+        CLI): mesh width + per-device health from the datapath, the last
+        re-mesh record, cumulative salvage stats, and the live grace
+        window."""
+        dp = self.datapath
+        mh = getattr(dp, "mesh_health", None)
+        doc: Dict = {"enabled": bool(self.config.remesh_enabled),
+                     "mesh": mh() if mh is not None else None,
+                     "last_remesh": self._remesh_last,
+                     "stats": dict(getattr(dp, "remesh_stats", {}) or {})}
+        grace = self._salvage_until - time.monotonic()
+        doc["salvage_grace_remaining_s"] = round(grace, 3) \
+            if grace > 0 else 0.0
+        return doc
 
     def sweep(self, now: Optional[int] = None) -> int:
         """CT garbage collection, host-driven whole-table mode (upstream
@@ -1327,6 +1597,18 @@ class Engine:
                 # occupancy against the worst case at batch_size (a full
                 # bucket is the steady serving state, not a failure)
                 out["rss_exchange"] = (s["capacity"], s["in_use"], 0.0)
+        mh = getattr(dp, "mesh_health", None)
+        if mh is not None:
+            h = mh()
+            if h["configured"] > 1:
+                # mesh_width (ISSUE 19): occupancy = devices actually
+                # serving, capacity = configured width. A missing chip IS
+                # failure pressure, so hand the missing fraction through
+                # explicitly — the default occupancy/capacity convention
+                # would read the full healthy mesh as the pressured state
+                out["mesh_width"] = (
+                    h["configured"], h["live"],
+                    (h["configured"] - h["live"]) / h["configured"])
         import sys as _sys
         cls_mod = _sys.modules.get("cilium_tpu.kernels.classify")
         if cls_mod is not None:
@@ -1460,6 +1742,19 @@ class Engine:
             self.controllers.update(
                 "parity-audit", lambda: self.audit_step(budget=64),
                 interval=self.config.audit_interval_s)
+        if self.config.remesh_enabled \
+                and getattr(self.datapath, "mesh_health", None) is not None:
+            # mesh self-healing (ISSUE 19): down-remesh on latched device
+            # loss, canary-probe departed chips, hysteretic re-admission
+            self.controllers.update(
+                "mesh-heal", self.remesh_step,
+                interval=self.config.remesh_interval_s)
+        if self.config.ct_snapshot_dir:
+            # bounded-staleness CT archive — the salvage floor a device-
+            # loss re-mesh falls back to when the gather collective fails
+            self.controllers.update(
+                "ct-snapshot", self.ct_snapshot_step,
+                interval=self.config.ct_snapshot_interval_s)
 
     def _autotune_step(self):
         """One autotune control interval (controller body). No-ops until
@@ -1569,6 +1864,41 @@ class Engine:
             if ost["level"] >= OVERLOAD_OVERLOAD \
                     and doc["state"] == C.HEALTH_OK:
                 doc["state"] = C.HEALTH_DEGRADED
+        mhf = getattr(self.datapath, "mesh_health", None)
+        if mhf is not None:
+            mw = mhf()
+            if mw["configured"] > 1 and (mw["dead_ordinals"]
+                                         or mw["live"] < mw["configured"]):
+                # device loss (ISSUE 19): serving continues on the
+                # survivor mesh — degraded, one fault from losing
+                # redundancy — with the live grace window attached so an
+                # operator can tell salvage-covered from cold-learning
+                grace = self._salvage_until - time.monotonic()
+                doc["devices"] = {
+                    "detail": C.DEVICE_LOST,
+                    "configured": mw["configured"],
+                    "live": mw["live"],
+                    "dead": mw["dead_ordinals"],
+                    "salvage_grace_remaining_s":
+                        round(grace, 3) if grace > 0 else 0.0,
+                }
+                if doc["state"] == C.HEALTH_OK:
+                    doc["state"] = C.HEALTH_DEGRADED
+        cfg = self.config
+        if cfg.ct_snapshot_dir and cfg.checkpoint_max_age_s > 0:
+            from cilium_tpu.runtime.checkpoint import ct_archive_age_s
+            age = ct_archive_age_s(cfg.ct_snapshot_dir)
+            if age is None or age > cfg.checkpoint_max_age_s:
+                # CHECKPOINT_STALE (ISSUE 19): the salvage floor a
+                # device-loss re-mesh would fall back to no longer
+                # reflects recent flows (or was never written)
+                doc["checkpoint"] = {
+                    "detail": C.CHECKPOINT_STALE,
+                    "age_s": round(age, 1) if age is not None else None,
+                    "max_age_s": cfg.checkpoint_max_age_s,
+                }
+                if doc["state"] == C.HEALTH_OK:
+                    doc["state"] = C.HEALTH_DEGRADED
         rs = self.ledger.status()
         if rs["pressured"]:
             # RESOURCE_PRESSURE detail (ISSUE 13): some bounded structure
@@ -1606,7 +1936,8 @@ class Engine:
             from cilium_tpu.pipeline.guard import PIPELINE_STATES
             self.metrics.set_gauge("pipeline_state",
                                    PIPELINE_STATES.get(pstate, -1))
-            if pstate in ("breaker-open", "restarting", "failed") \
+            if pstate in ("breaker-open", "restarting", "failed",
+                          "device-lost") \
                     and doc["state"] == C.HEALTH_OK:
                 doc["state"] = C.HEALTH_DEGRADED
         return doc

@@ -122,6 +122,25 @@ POINTS: Dict[str, str] = {
                   "fqdn_parse_errors_total — while the replies keep their "
                   "verdicts bit-identical (the fail-open contract a "
                   "broken parser must honor; chaos phase dns-poison)",
+    "device.fail": "a dead accelerator in the flow-shard mesh: fired on "
+                   "every sharded classify dispatch AND by "
+                   "JITDatapath.probe_device. Arm with message=dev=K to "
+                   "name the victim ordinal — the datapath's real-error "
+                   "classifier (dead_device_of) recognizes the trip as a "
+                   "dead-device signature (NOT breaker/backoff territory), "
+                   "latches the per-device health record, and raises "
+                   "DeviceLost so the engine fences and re-meshes onto the "
+                   "survivors. A trip naming an ordinal already OUT of the "
+                   "serving mesh is swallowed (a dead chip cannot hurt a "
+                   "mesh it is not in) — that is what lets degraded serving "
+                   "continue while the fault stays armed, and what makes "
+                   "disarming it the bench's heal signal",
+    "device.collective": "the host CT gather inside JITDatapath.remesh "
+                         "(the salvage collective): a trip means the "
+                         "surviving shards' tables could not be gathered — "
+                         "salvage falls back to the ct-snapshot archive "
+                         "floor (bounded staleness) or a cold table, "
+                         "counted in ct_salvage_source_total",
 }
 
 #: hard clamp on ``hang`` stalls: whatever cap a scenario asks for, a

@@ -71,6 +71,55 @@ def flow_hashes(b: Dict[str, np.ndarray]) -> np.ndarray:
             ^ hash_words_np(ct_key_words(b, reverse=True)))
 
 
+class EstablishedFingerprints:
+    """Direct-mapped fingerprint table of flows observed allowed-
+    ESTABLISHED/REPLY (pow2 slots; slot ``h & mask`` holds ``h | 1`` so an
+    empty slot can never read as a hit for hash 0). Two consumers share
+    the exact same update/lookup discipline:
+
+    - the feeder's harvest-time priority classing (a hit ranks the row
+      PRIO_ESTABLISHED — heuristic only, a collision merely promotes a
+      colliding flow's class);
+    - the engine's post-remesh CT-salvage grace window (ISSUE 19): a
+      denied row whose fingerprint was established before the device loss
+      rides through while the survivor mesh's CT cold-learns — there a
+      collision admits one flow for a bounded window, which is exactly
+      the documented grace contract, never a policy bypass outside it.
+
+    ``note`` never raises (both call sites are verdict hot paths)."""
+
+    def __init__(self, slots: int = EST_FILTER_SLOTS):
+        if slots < 1 or slots & (slots - 1):
+            raise ValueError("fingerprint slots must be a power of two")
+        self._tab = np.zeros((slots,), dtype=np.uint32)
+        self._mask = np.uint32(slots - 1)
+
+    def note(self, buf: Dict[str, np.ndarray],
+             out: Dict[str, np.ndarray]) -> None:
+        """Stamp fingerprints for rows applied allowed-ESTABLISHED/REPLY."""
+        try:
+            st = np.asarray(out["status"])
+            m = (np.asarray(out["allow"])
+                 & ((st == int(C.CTStatus.ESTABLISHED))
+                    | (st == int(C.CTStatus.REPLY)))
+                 & np.asarray(buf["valid"]))
+            if not m.any():
+                return
+            cols = {k: np.asarray(buf[k])[m]
+                    for k in ("src", "dst", "sport", "dport", "proto",
+                              "direction")}
+            h = flow_hashes(cols)
+            self._tab[h & self._mask] = h | np.uint32(1)
+        except Exception:   # noqa: BLE001 — heuristic, never load-bearing
+            log.exception("established-fingerprint update failed")
+
+    def hits(self, buf: Dict[str, np.ndarray]) -> np.ndarray:
+        """[N] bool: rows whose direction-normalized fingerprint is
+        stamped. Row-aligned with ``buf``; validity is the caller's mask."""
+        h = flow_hashes(buf)
+        return self._tab[h & self._mask] == (h | np.uint32(1))
+
+
 def shed_new_rows(b: Dict[str, np.ndarray]) -> int:
     """The SHED-NEW harvest-time shed, shared by the feeder and the cfg6
     bench's synthetic harvest: invalidate every valid row whose ``_prio``
@@ -177,8 +226,7 @@ class ShimFeeder:
         for buf in self._free:
             buf["_prio"] = np.full((shim.batch_size,), PRIO_NEW,
                                    dtype=np.int8)
-        self._est_filter = np.zeros((EST_FILTER_SLOTS,), dtype=np.uint32)
-        self._est_mask = np.uint32(EST_FILTER_SLOTS - 1)
+        self._est = EstablishedFingerprints()
         # multi-tenant QoS (cilium_tpu/qos): with a TenantTable armed,
         # every poll buffer carries a ``_tenant`` column stamped at
         # harvest time from the endpoint→tenant LUT (same compiled-LUT
@@ -458,9 +506,7 @@ class ShimFeeder:
             # SHED-NEW harvest shed keys on
             from cilium_tpu.pipeline.guard import (PRIO_ESTABLISHED,
                                                    PRIO_NEW, PRIO_UNKNOWN)
-            h = flow_hashes(b)
-            hit = self._est_filter[h & self._est_mask] \
-                == (h | np.uint32(1))
+            hit = self._est.hits(b)
             pr = np.where(hit, PRIO_ESTABLISHED, PRIO_NEW).astype(np.int8)
             pr[unknown] = PRIO_UNKNOWN
             b["_prio"][:] = pr
@@ -513,23 +559,10 @@ class ShimFeeder:
     def _note_established(self, buf, out) -> None:
         """Feed the established-flow filter from applied verdicts: flows
         observed allowed-ESTABLISHED/REPLY stamp their fingerprint, so the
-        NEXT harvest ranks them class 0. Never raises (verdict-apply hot
-        path); collisions only promote a colliding flow's class."""
-        try:
-            st = np.asarray(out["status"])
-            m = (np.asarray(out["allow"])
-                 & ((st == int(C.CTStatus.ESTABLISHED))
-                    | (st == int(C.CTStatus.REPLY)))
-                 & np.asarray(buf["valid"]))
-            if not m.any():
-                return
-            cols = {k: np.asarray(buf[k])[m]
-                    for k in ("src", "dst", "sport", "dport", "proto",
-                              "direction")}
-            h = flow_hashes(cols)
-            self._est_filter[h & self._est_mask] = h | np.uint32(1)
-        except Exception:   # noqa: BLE001 — heuristic, never load-bearing
-            log.exception("established-filter update failed")
+        NEXT harvest ranks them class 0 (EstablishedFingerprints — shared
+        with the engine's CT-salvage grace window, which needs the exact
+        same update/lookup discipline). Never raises."""
+        self._est.note(buf, out)
 
     # -- verdict application (FIFO) -------------------------------------------
     def _apply_ready(self, block: bool,

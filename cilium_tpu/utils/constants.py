@@ -218,6 +218,15 @@ RESOURCE_PRESSURE = "RESOURCE_PRESSURE"
 # serves last-good (never fail closed on established remote flows), but
 # the view may be behind the mesh; folds Engine.health() to DEGRADED.
 MESH_STALE = "MESH_STALE"
+# CT-archive staleness detail (ISSUE 19): the ct-snapshot controller's
+# newest archive is older than checkpoint_max_age_s — the salvage floor a
+# device-loss re-mesh would fall back to no longer reflects recent flows;
+# folds Engine.health() to DEGRADED until a snapshot lands.
+CHECKPOINT_STALE = "CHECKPOINT_STALE"
+# Device-loss detail (ISSUE 19): an accelerator in the configured mesh is
+# latched dead (runtime/datapath.device_health) — serving continues on the
+# survivor mesh, but the cluster is one fault from losing redundancy.
+DEVICE_LOST = "DEVICE_LOST"
 
 # --------------------------------------------------------------------------- #
 # L7-lite (config 4): tokenized HTTP method/path-prefix matching

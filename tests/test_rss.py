@@ -507,3 +507,66 @@ class TestDeviceRSSSoak:
             assert dev.datapath.pack_stats["pack_fallback_steered"] == 0
         finally:
             dev.stop()
+
+
+# --------------------------------------------------------------------------- #
+# Degraded survivor geometry under device-side RSS (ISSUE 19): the n-1
+# ring exchange is the same verdict machine, just narrower
+# --------------------------------------------------------------------------- #
+class TestDeviceRSSDegradedMesh:
+    @pytest.mark.slow
+    def test_device_rss_n_minus_1_parity_and_audit_clean(self):
+        """Both rss modes shrink 4 -> 3 BEFORE any traffic; the degraded
+        device-RSS mesh (ppermute ring over 3 chips) must stay
+        bit-identical to the degraded host-steered mesh and to the
+        oracle-backed serial path — including CT continuity in both
+        directions — with the shadow auditor at sampling 1.0 clean on
+        the device engine."""
+        FAULTS.reset()
+        serial = fake_serial_engine()
+        host = jit_pipeline_engine(4)
+        dev = jit_pipeline_engine(4, rss_mode="device",
+                                  audit_enabled=True,
+                                  audit_sample_rate=1.0,
+                                  audit_pool_batches=64)
+        dev.auditor.configure(sample_rate=1.0)
+        slot_of = serial.active.snapshot.ep_slot_of
+        try:
+            for eng in (host, dev):
+                eng.datapath.note_device_loss(2, reason="drill")
+                doc = eng.remesh_step()
+                assert doc["remesh"]["to"] == 3
+            assert dev.datapath.rss_state["shards"] == 3
+            assert dev.datapath.pipeline_shards == 1   # no pre-steering
+            assert host.datapath.pipeline_shards == 3
+
+            ch1 = _mk_phase(slot_of, 4, (1, 5, 17, 9), seed=91)
+            _run_phase(serial, [host, dev], ch1, now0=3000)
+            est = [pkt("192.168.1.10", "10.0.2.7", 49500 + i, 443)
+                   for i in range(4)]
+            _run_phase(serial, [host, dev],
+                       [batch_from_records(est, slot_of)], now0=3200)
+            reply = [pkt("10.0.2.7", "192.168.1.10", 443, 49500 + i,
+                         flags=C.TCP_ACK, direction=C.DIR_INGRESS)
+                     for i in range(4)]
+            outs = _run_phase(
+                serial, [host, dev],
+                [batch_from_records(reply, slot_of, pad_to=6)],
+                now0=3210)
+            assert (np.asarray(outs[0]["status"])[:4]
+                    == int(C.CTStatus.REPLY)).all()
+
+            live = serial.ct_stats(now=4000)["live"]
+            assert host.ct_stats(now=4000)["live"] == live
+            assert dev.ct_stats(now=4000)["live"] == live
+            for _ in range(100):
+                step = dev.audit_step(budget=128)
+                if not step or (not step.get("replayed")
+                                and not step.get("pending")):
+                    break
+            st = dev.auditor.stats()
+            assert st["checked_rows"] > 0
+            assert st["mismatched_rows"] == 0
+        finally:
+            for eng in (serial, host, dev):
+                eng.stop()

@@ -727,3 +727,71 @@ class TestShardedSoak:
         finally:
             FAULTS.reset()
             eng.stop()
+
+
+# --------------------------------------------------------------------------- #
+# Degraded survivor geometry (ISSUE 19): the n-1 mesh is a first-class
+# serving shape, not an error state — bit-identity to the oracle and a
+# clean parity audit must hold on it from a cold CT
+# --------------------------------------------------------------------------- #
+class TestDegradedMeshParity:
+    @pytest.mark.parametrize("n_shards,victim", [
+        (4, 1),
+        pytest.param(8, 5, marks=pytest.mark.slow),
+    ])
+    def test_n_minus_1_bit_identical_to_serial(self, n_shards, victim):
+        """Shrink the mesh BEFORE any traffic (a device latched dead, one
+        remesh tick onto the survivors), then run the sharded parity
+        phases on the degraded geometry: fresh flows with partial
+        buckets, CT continuity in BOTH directions, and the shadow
+        auditor at sampling 1.0 staying clean — proving degraded serving
+        is the same verdict machine, just narrower."""
+        FAULTS.reset()
+        serial = fake_serial_engine()
+        eng = jit_pipeline_engine(n_shards, audit_enabled=True,
+                                  audit_sample_rate=1.0,
+                                  audit_pool_batches=64)
+        eng.auditor.configure(sample_rate=1.0)
+        slot_of = serial.active.snapshot.ep_slot_of
+        try:
+            eng.datapath.note_device_loss(victim, reason="drill")
+            doc = eng.remesh_step()
+            assert doc["remesh"]["from"] == n_shards
+            assert doc["remesh"]["to"] == n_shards - 1
+            assert victim not in \
+                eng.datapath.mesh_health()["live_ordinals"]
+
+            ch1 = _mk_phase(slot_of, 5, (1, 5, 17, 9, 23),
+                            seed=60 + n_shards)
+            _run_phase(serial, [eng], ch1, now0=1000)
+
+            est = [pkt("192.168.1.10", "10.0.2.7", 49300 + i, 443)
+                   for i in range(4)]
+            outs = _run_phase(serial, [eng],
+                              [batch_from_records(est, slot_of)],
+                              now0=1200)
+            assert outs[0]["allow"].all()
+            reply = [pkt("10.0.2.7", "192.168.1.10", 443, 49300 + i,
+                         flags=C.TCP_ACK, direction=C.DIR_INGRESS)
+                     for i in range(4)]
+            outs2 = _run_phase(
+                serial, [eng],
+                [batch_from_records(reply, slot_of, pad_to=6)],
+                now0=1210)
+            # the degraded steer kept both directions on one survivor
+            # shard: replies really hit CT
+            assert (np.asarray(outs2[0]["status"])[:4]
+                    == int(C.CTStatus.REPLY)).all()
+
+            assert eng.pipeline_stats()["n_shards"] == n_shards - 1
+            for _ in range(100):
+                step = eng.audit_step(budget=128)
+                if not step or (not step.get("replayed")
+                                and not step.get("pending")):
+                    break
+            st = eng.auditor.stats()
+            assert st["checked_rows"] > 0
+            assert st["mismatched_rows"] == 0
+        finally:
+            serial.stop()
+            eng.stop()

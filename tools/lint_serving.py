@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Serving-path exception-hygiene lint (ISSUE 19 satellite).
+
+The self-healing plane only works if failures SURFACE: device-loss
+detection reads dispatch exceptions, the flight recorder narrates them,
+and the health document folds them in. A ``except Exception: pass``
+anywhere on the serving path silently eats exactly the evidence that
+machinery runs on — the classic way a dead chip serves garbage for an
+hour before anyone notices.
+
+This AST walk enforces, over ``cilium_tpu/{pipeline,runtime,shim}``:
+
+- **no swallowed broad catches**: a handler for ``Exception`` /
+  ``BaseException`` / bare ``except:`` whose body is only ``pass`` (or
+  ``...``) is an error unless the handler line carries an explicit
+  ``# noqa: BLE001``-style label stating why swallowing is safe;
+- **no unlabelled broad catches**: every other broad handler must either
+  re-raise somewhere in its body, make at least one call (accounting:
+  ``log.exception``, a counter bump, the device-loss triage, ...), or
+  carry an explicit ``# noqa: BLE001``-style label on the handler line —
+  the repo's convention for "never-raise by design, accounted".
+
+Narrow catches (``except OSError:`` etc.) are out of scope: naming the
+exception IS the label. Exit 0 clean, 1 with findings, 2 on usage/parse
+errors — wired as ``make lint-serving``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+#: serving-path packages, relative to the repo root
+SERVING_DIRS = ("cilium_tpu/pipeline", "cilium_tpu/runtime",
+                "cilium_tpu/shim")
+
+BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:                                  # bare except:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD
+                   for e in t.elts)
+    return False
+
+
+def _pass_only(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant) \
+                and stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def _reraises(body: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for stmt in body for n in ast.walk(stmt))
+
+
+def _has_call(body: List[ast.stmt]) -> bool:
+    """At least one call anywhere in the handler body — the accounting
+    floor (a log line, a counter bump, a triage helper)."""
+    return any(isinstance(n, ast.Call)
+               for stmt in body for n in ast.walk(stmt))
+
+
+def _labelled(lines: List[str], handler: ast.ExceptHandler) -> bool:
+    """noqa/BLE001 marker on the handler's header line(s): from the
+    ``except`` keyword through the line before the first body statement
+    (multi-line headers keep their label visible)."""
+    first_body = handler.body[0].lineno if handler.body else handler.lineno
+    for ln in range(handler.lineno, first_body + 1):
+        if ln - 1 >= len(lines):
+            break
+        text = lines[ln - 1]
+        if "noqa" in text or "BLE001" in text:
+            return True
+    return False
+
+
+def lint_file(path: str) -> List[Tuple[int, str]]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    findings: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+            continue
+        what = ast.unparse(node.type) if node.type is not None else "<bare>"
+        labelled = _labelled(lines, node)
+        if _pass_only(node.body):
+            if not labelled:
+                findings.append((
+                    node.lineno,
+                    f"swallowed broad catch (except {what}: pass) — "
+                    f"failures on the serving path must surface, be "
+                    f"accounted, or carry a `# noqa: BLE001 — <why>` "
+                    f"label"))
+            continue
+        if _reraises(node.body) or labelled:
+            continue
+        if not _has_call(node.body):
+            findings.append((
+                node.lineno,
+                f"unlabelled broad catch (except {what}) with no re-raise "
+                f"and no accounting call — add the handling, or label it "
+                f"`# noqa: BLE001 — <why never-raise is safe here>`"))
+    return findings
+
+
+def main(argv: List[str]) -> int:
+    root = argv[1] if len(argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [os.path.join(root, d) for d in SERVING_DIRS]
+    missing = [t for t in targets if not os.path.isdir(t)]
+    if missing:
+        print(f"lint-serving: missing serving dirs: {missing}",
+              file=sys.stderr)
+        return 2
+    total = 0
+    for tdir in targets:
+        for dirpath, _dirnames, filenames in sorted(os.walk(tdir)):
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                for lineno, msg in lint_file(path):
+                    rel = os.path.relpath(path, root)
+                    print(f"{rel}:{lineno}: {msg}")
+                    total += 1
+    if total:
+        print(f"lint-serving: {total} finding(s)", file=sys.stderr)
+        return 1
+    print("lint-serving: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
